@@ -1,0 +1,73 @@
+"""blance_tpu — TPU-native partition assignment & rebalance orchestration.
+
+A ground-up framework with the capabilities of couchbase/blance
+(reference mounted at /root/reference): plan balanced partition->node
+assignments under prioritized states, constraints, weights, stickiness and
+rack/zone hierarchy rules; diff two maps into minimal ordered move sequences;
+and orchestrate those moves with per-node concurrency limits, pluggable
+prioritization, pause/resume/stop and streamed progress.
+
+The planner's hot path is a batched (partitions x states x nodes) cost tensor
+in JAX, sharded over the partition axis (see blance_tpu.plan.tensor and
+blance_tpu.parallel); the exact sequential planner (blance_tpu.plan.greedy)
+is the semantics oracle and small-problem backend.
+"""
+
+from .core.types import (
+    HierarchyRule,
+    HierarchyRules,
+    Partition,
+    PartitionMap,
+    PartitionModel,
+    PartitionModelState,
+    PlanOptions,
+    copy_partition_map,
+    model,
+    partition_map_from_json,
+    partition_map_to_json,
+)
+from .core.setops import (
+    strings_dedup,
+    strings_intersect,
+    strings_remove,
+    strings_to_set,
+)
+from .moves.calc import NodeStateOp, calc_partition_moves
+from .plan.api import plan_next_map
+from .plan.greedy import (
+    NodeScoreContext,
+    count_state_nodes,
+    default_node_score,
+    flatten_nodes_by_state,
+    plan_next_map_greedy,
+    sort_state_names,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "HierarchyRule",
+    "HierarchyRules",
+    "Partition",
+    "PartitionMap",
+    "PartitionModel",
+    "PartitionModelState",
+    "PlanOptions",
+    "NodeScoreContext",
+    "NodeStateOp",
+    "calc_partition_moves",
+    "copy_partition_map",
+    "count_state_nodes",
+    "default_node_score",
+    "flatten_nodes_by_state",
+    "model",
+    "partition_map_from_json",
+    "partition_map_to_json",
+    "plan_next_map",
+    "plan_next_map_greedy",
+    "sort_state_names",
+    "strings_dedup",
+    "strings_intersect",
+    "strings_remove",
+    "strings_to_set",
+]

@@ -1,0 +1,169 @@
+"""Core data model: partitions, maps, models, hierarchy rules, plan options.
+
+This mirrors the reference's data model (reference: /root/reference/api.go:24-190)
+but as Python dataclasses that are trivially JSON round-trippable — the
+PartitionMap *is* the checkpoint format of the framework, so keeping it plain
+is a design requirement (reference api.go:30,35 json tags).
+
+Unlike the reference, hooks (node scorer / score booster) live on
+``PlanOptions`` instead of mutable package globals, so concurrent plans with
+different policies can't interfere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional
+
+__all__ = [
+    "Partition",
+    "PartitionMap",
+    "PartitionModelState",
+    "PartitionModel",
+    "HierarchyRule",
+    "HierarchyRules",
+    "PlanOptions",
+    "partition_map_to_json",
+    "partition_map_from_json",
+    "copy_partition",
+    "copy_partition_map",
+    "model",
+]
+
+
+@dataclass
+class Partition:
+    """A distinct shard of a logical resource (reference api.go:28-36).
+
+    ``nodes_by_state`` maps state name -> ordered node list.  Order is
+    meaningful: index 0 of the top-priority state is "the primary" used for
+    hierarchy anchoring and replica-spread accounting.
+    """
+
+    name: str
+    nodes_by_state: dict[str, list[str]] = field(default_factory=dict)
+
+    def copy(self) -> "Partition":
+        return Partition(
+            name=self.name,
+            nodes_by_state={s: list(nodes) for s, nodes in self.nodes_by_state.items()},
+        )
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "nodesByState": self.nodes_by_state}
+
+    @staticmethod
+    def from_json(d: Mapping) -> "Partition":
+        return Partition(
+            name=d["name"],
+            nodes_by_state={s: list(nodes) for s, nodes in d.get("nodesByState", {}).items()},
+        )
+
+
+# PartitionMap is keyed by Partition.name (reference api.go:24).
+PartitionMap = dict[str, Partition]
+
+
+@dataclass(frozen=True)
+class PartitionModelState:
+    """Metadata for one partition state (reference api.go:46-62).
+
+    priority: 0 is highest ("primary" < "replica").
+    constraints: how many nodes should hold this state per partition.
+    """
+
+    priority: int = 0
+    constraints: int = 0
+
+
+# PartitionModel is keyed by state name (reference api.go:41).
+PartitionModel = dict[str, PartitionModelState]
+
+
+@dataclass(frozen=True)
+class HierarchyRule:
+    """Rack/zone awareness rule (reference api.go:96-105).
+
+    include_level: ancestors to climb to find the candidate subtree.
+    exclude_level: ancestors to climb to find the excluded subtree.
+    e.g. include 1 / exclude 0 = "same rack, different node";
+    include 2 / exclude 1 = "different rack, same datacenter".
+    """
+
+    include_level: int = 0
+    exclude_level: int = 0
+
+
+# HierarchyRules is keyed by state name; value is an ordered rule list, one
+# entry consulted per replica ordinal (reference api.go:64-74).
+HierarchyRules = dict[str, list[HierarchyRule]]
+
+
+# Signature of the score-booster hook: (node_weight, stickiness) -> score boost.
+# Applied when a node's weight is negative (reference plan.go:675-684,693-697).
+NodeScoreBoosterFunc = Callable[[int, float], float]
+
+
+@dataclass
+class PlanOptions:
+    """Optional planner knobs (reference api.go:183-190 + package globals).
+
+    The reference exposes ``MaxIterationsPerPlan``, ``CustomNodeSorter`` and
+    ``NodeScoreBooster`` as mutable package globals (plan.go:21,580,693); here
+    they are per-call options.
+    """
+
+    # Override the constraints defined in the model, keyed by state name.
+    model_state_constraints: Optional[dict[str, int]] = None
+    # Keyed by partition name; default weight 1.
+    partition_weights: Optional[dict[str, int]] = None
+    # Keyed by state name; default stickiness 1.5.  NOTE (reference quirk,
+    # plan.go:104-115): the reference consults state_stickiness only when
+    # partition_weights is non-nil; we reproduce that for parity unless
+    # ``state_stickiness_standalone`` is set.
+    state_stickiness: Optional[dict[str, int]] = None
+    # Keyed by node name; default weight 1.  Negative weights trigger the
+    # node_score_booster hook.
+    node_weights: Optional[dict[str, int]] = None
+    # Keyed by node; value is the node's parent in the containment hierarchy.
+    node_hierarchy: Optional[dict[str, str]] = None
+    # Keyed by state name; replica placement policy.
+    hierarchy_rules: Optional[HierarchyRules] = None
+
+    # --- hooks (package globals in the reference) ---
+    max_iterations: int = 10  # reference plan.go:21
+    node_score_booster: Optional[NodeScoreBoosterFunc] = None  # plan.go:693
+    # Custom node scorer: replaces the default score formula entirely.
+    # Called as fn(ctx: NodeScoreContext, node: str) -> float; ties still break
+    # by node position (reference plan.go:580 CustomNodeSorter).
+    node_scorer: Optional[Callable] = None
+
+    # --- compat switches ---
+    # When True, state_stickiness applies even without partition_weights
+    # (fixes the reference quirk at plan.go:104-115).
+    state_stickiness_standalone: bool = False
+
+
+def model(**states: tuple[int, int]) -> PartitionModel:
+    """Convenience builder: model(primary=(0, 1), replica=(1, 2))."""
+    return {
+        name: PartitionModelState(priority=pc[0], constraints=pc[1])
+        for name, pc in states.items()
+    }
+
+
+def copy_partition(p: Partition) -> Partition:
+    return p.copy()
+
+
+def copy_partition_map(m: PartitionMap) -> PartitionMap:
+    """Deep copy (reference plan.go:334-351 toArrayCopy/copyNodesByState)."""
+    return {name: p.copy() for name, p in m.items()}
+
+
+def partition_map_to_json(m: PartitionMap) -> dict:
+    return {name: p.to_json() for name, p in m.items()}
+
+
+def partition_map_from_json(d: Mapping) -> PartitionMap:
+    return {name: Partition.from_json(p) for name, p in d.items()}

@@ -1,0 +1,60 @@
+"""Public planning entry point with backend selection.
+
+``plan_next_map`` is the equivalent of the reference's PlanNextMapEx
+(reference: /root/reference/api.go:147-157).  Backends:
+
+- "greedy": the exact sequential planner (semantics oracle; plan/greedy.py).
+- "tpu":    the batched cost-tensor planner (plan/tensor.py) — whole-problem
+            scoring on device, constraint repair, sharded over partitions.
+- "auto":   "tpu" for large problems, "greedy" otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from .greedy import plan_next_map_greedy
+
+__all__ = ["plan_next_map"]
+
+# Below this many (partitions x nodes), the exact greedy is faster than a
+# device round-trip; above it, the batched solver wins.
+_AUTO_TPU_THRESHOLD = 256 * 1024
+
+
+def plan_next_map(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]] = None,
+    nodes_to_add: Optional[list[str]] = None,
+    model: Optional[PartitionModel] = None,
+    opts: Optional[PlanOptions] = None,
+    backend: str = "greedy",
+) -> tuple[PartitionMap, dict[str, list[str]]]:
+    """Compute the next balanced partition map.
+
+    Returns (next_map, warnings) where warnings is keyed by partition name
+    (constraint shortfalls degrade to warnings, never errors — reference
+    plan.go:231-235).
+    """
+    if model is None:
+        raise ValueError("model is required")
+    opts = opts or PlanOptions()
+
+    if backend == "auto":
+        size = len(partitions_to_assign) * len(nodes_all)
+        backend = "tpu" if size >= _AUTO_TPU_THRESHOLD else "greedy"
+
+    if backend == "greedy":
+        return plan_next_map_greedy(
+            prev_map, partitions_to_assign, nodes_all,
+            nodes_to_remove, nodes_to_add, model, opts)
+    if backend == "tpu":
+        from .tensor import plan_next_map_tpu  # deferred: imports jax
+
+        return plan_next_map_tpu(
+            prev_map, partitions_to_assign, nodes_all,
+            nodes_to_remove, nodes_to_add, model, opts)
+    raise ValueError(f"unknown backend: {backend!r}")

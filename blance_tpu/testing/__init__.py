@@ -1,0 +1,1 @@
+"""blance_tpu.testing subpackage."""

@@ -1,0 +1,146 @@
+"""Visual placement DSL for golden planner tests.
+
+Reimplements the reference's ASCII test harness (reference:
+/root/reference/plan_test.go:1611-1744): each partition is one row; columns
+are nodes "a", "b", "c", ...; cell tokens name the state the node holds —
+"m" = primary, "s" = replica — optionally followed by a replica ordinal when
+``cell_length=2`` ("m0", "s0", "s1"), in which case node order within a state
+follows the ordinal.  This is what keeps thousands of lines of placement
+expectations readable, and it only works because the planner is fully
+deterministic (stable sorts, node-position tie-breaks, sorted hierarchy
+children).
+
+Example row pair (from "m s" to "sm "): partition moved its primary from
+node a to node b and grew a replica on a.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.types import (
+    HierarchyRules,
+    Partition,
+    PartitionMap,
+    PartitionModel,
+    PlanOptions,
+)
+from ..plan.api import plan_next_map
+
+__all__ = ["VisCase", "parse_vis_row", "vis_maps", "run_vis_cases", "format_vis_map"]
+
+_STATE_NAMES = {"m": "primary", "s": "replica"}
+
+
+def _node_name(i: int) -> str:
+    return chr(ord("a") + i)
+
+
+def parse_vis_row(row: str, cell_length: int) -> dict[str, list[str]]:
+    """One ASCII row -> nodes_by_state.
+
+    Cells are read per node column, then sorted by cell text so replica
+    ordinals ("s0" < "s1") define list order (plan_test.go:1677-1692).
+    """
+    cells: list[tuple[str, str]] = []
+    for j in range(0, len(row), cell_length):
+        cells.append((row[j : j + cell_length], _node_name(j // cell_length)))
+    cells.sort(key=lambda c: c[0])
+    nbs: dict[str, list[str]] = {}
+    for entry, node in cells:
+        state = _STATE_NAMES.get(entry[0:1])
+        if state:
+            nbs.setdefault(state, []).append(node)
+    return nbs
+
+
+def format_vis_map(
+    pmap: PartitionMap, nodes: list[str], cell_length: int = 1
+) -> list[str]:
+    """Inverse of parse_vis_row, for readable test failure output."""
+    state_letter = {v: k for k, v in _STATE_NAMES.items()}
+    rows = []
+    for pname in sorted(pmap):
+        p = pmap[pname]
+        cells = {n: " " * cell_length for n in nodes}
+        for state, snodes in p.nodes_by_state.items():
+            for ordinal, node in enumerate(snodes):
+                letter = state_letter.get(state, "?")
+                cell = letter if cell_length == 1 else f"{letter}{ordinal}"
+                cells[node] = cell
+        rows.append("".join(cells[n] for n in nodes))
+    return rows
+
+
+@dataclass
+class VisCase:
+    """One golden scenario (plan_test.go:1611-1627)."""
+
+    about: str
+    from_to: list[tuple[str, str]]
+    nodes: list[str]
+    model: PartitionModel
+    nodes_to_remove: list[str] = field(default_factory=list)
+    nodes_to_add: list[str] = field(default_factory=list)
+    from_to_priority: bool = False
+    model_state_constraints: Optional[dict[str, int]] = None
+    partition_weights: Optional[dict[str, int]] = None
+    state_stickiness: Optional[dict[str, int]] = None
+    node_weights: Optional[dict[str, int]] = None
+    node_hierarchy: Optional[dict[str, str]] = None
+    hierarchy_rules: Optional[HierarchyRules] = None
+    exp_num_warnings: int = 0  # partitions-with-warnings count
+    ignore: bool = False
+    backend: str = "greedy"
+
+
+def vis_maps(case: VisCase) -> tuple[PartitionMap, PartitionMap]:
+    """Build (prev_map, expected_map) from the from/to rows."""
+    cell_length = 2 if case.from_to_priority else 1
+    prev_map: PartitionMap = {}
+    exp_map: PartitionMap = {}
+    for i, (frm, to) in enumerate(case.from_to):
+        pname = f"{i:03d}"
+        prev_map[pname] = Partition(pname, parse_vis_row(frm, cell_length))
+        exp_map[pname] = Partition(pname, parse_vis_row(to, cell_length))
+    return prev_map, exp_map
+
+
+def run_vis_cases(cases: list[VisCase]) -> None:
+    """Plan each case and assert the golden map + warning count."""
+    for i, case in enumerate(cases):
+        if case.ignore:
+            continue
+        prev_map, exp_map = vis_maps(case)
+        opts = PlanOptions(
+            model_state_constraints=case.model_state_constraints,
+            partition_weights=case.partition_weights,
+            state_stickiness=case.state_stickiness,
+            node_weights=case.node_weights,
+            node_hierarchy=case.node_hierarchy,
+            hierarchy_rules=case.hierarchy_rules,
+        )
+        result, warnings = plan_next_map(
+            prev_map,
+            prev_map,
+            case.nodes,
+            case.nodes_to_remove,
+            case.nodes_to_add,
+            case.model,
+            opts,
+            backend=case.backend,
+        )
+        cell_length = 2 if case.from_to_priority else 1
+        got = {name: p.nodes_by_state for name, p in result.items()}
+        exp = {name: p.nodes_by_state for name, p in exp_map.items()}
+        assert got == exp, (
+            f"case {i} ({case.about}):\n"
+            f"got:\n" + "\n".join(format_vis_map(result, case.nodes, cell_length))
+            + "\nexpected:\n"
+            + "\n".join(format_vis_map(exp_map, case.nodes, cell_length))
+        )
+        assert len(warnings) == case.exp_num_warnings, (
+            f"case {i} ({case.about}): warnings {warnings} "
+            f"expected {case.exp_num_warnings} partitions-with-warnings"
+        )

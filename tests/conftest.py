@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices BEFORE jax is
+imported anywhere, so multi-chip sharding tests (jax.sharding.Mesh over 8
+devices) run on machines with no TPU attached.  Real-TPU benchmarking happens
+in bench.py, not in the test suite.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,94 @@
+"""Ports of the reference's hierarchy-tree unit tests (plan_test.go:305-390)."""
+
+from blance_tpu.core.hierarchy import (
+    find_ancestor,
+    find_leaves,
+    include_exclude_nodes,
+    include_exclude_nodes_intersect,
+    level_group_ids,
+    parents_to_children,
+)
+
+
+def test_find_ancestor():
+    cases = [
+        (0, {}, "a"),
+        (1, {}, ""),
+        (2, {}, ""),
+        (0, {"a": "r"}, "a"),
+        (1, {"a": "r"}, "r"),
+        (2, {"a": "r"}, ""),
+        (3, {"a": "r"}, ""),
+        (0, {"a": "r", "r": "g"}, "a"),
+        (1, {"a": "r", "r": "g"}, "r"),
+        (2, {"a": "r", "r": "g"}, "g"),
+        (3, {"a": "r", "r": "g"}, ""),
+    ]
+    for level, parents, exp in cases:
+        assert find_ancestor("a", parents, level) == exp
+
+
+def test_find_leaves():
+    cases = [
+        ({}, ["a"]),
+        ({"x": ["xx"]}, ["a"]),
+        ({"a": []}, ["a"]),
+        ({"a": ["b"]}, ["b"]),
+        ({"a": ["b", "c"]}, ["b", "c"]),
+        ({"a": ["b", "c"], "c": ["c1", "c2"]}, ["b", "c1", "c2"]),
+    ]
+    for children, exp in cases:
+        assert find_leaves("a", children) == exp
+
+
+def test_parents_to_children():
+    cases = [
+        ({}, {}),
+        ({"a": "r"}, {"r": ["a"]}),
+        ({"a": "r", "b": "r2"}, {"r": ["a"], "r2": ["b"]}),
+        ({"a": "r", "a1": "a"}, {"r": ["a"], "a": ["a1"]}),
+        ({"a": "r", "a1": "a", "a2": "a"}, {"r": ["a"], "a": ["a1", "a2"]}),
+        # Children come out sorted by name for determinism.
+        ({"a": "r", "a1": "a", "a2": "a", "a0": "a"},
+         {"r": ["a"], "a": ["a0", "a1", "a2"]}),
+    ]
+    for parents, exp in cases:
+        assert parents_to_children(parents) == exp
+
+
+_TREE = {
+    "a": "r0", "b": "r0", "c": "r1", "d": "r1",
+    "r0": "z0", "r1": "z0",
+}
+
+
+def test_include_exclude_nodes():
+    children = parents_to_children(_TREE)
+    # Same rack as a (include 1), excluding a itself (exclude 0).
+    assert include_exclude_nodes("a", 1, 0, _TREE, children) == ["b"]
+    # Different rack than a: include zone (2), exclude rack (1).
+    assert include_exclude_nodes("a", 2, 1, _TREE, children) == ["c", "d"]
+    # Degenerate: include self only.
+    assert include_exclude_nodes("a", 0, 0, _TREE, children) == []
+    # Beyond the root: the missing-ancestor "" sentinel survives as a leaf
+    # (it is filtered later by intersecting with real nodes).
+    assert include_exclude_nodes("a", 3, 2, _TREE, children) == [""]
+
+
+def test_include_exclude_nodes_intersect():
+    children = parents_to_children(_TREE)
+    # Anchored on a and c: nodes in a different rack from both -> none
+    # (everything is in r0 or r1).
+    assert include_exclude_nodes_intersect(["a", "c"], 2, 1, _TREE, children) == []
+    # Anchored on a only, via the intersect API.
+    assert include_exclude_nodes_intersect(["a"], 2, 1, _TREE, children) == ["c", "d"]
+
+
+def test_level_group_ids():
+    gids = level_group_ids(["a", "b", "c", "d"], _TREE, 2)
+    # Level 0: every node its own group.
+    assert gids[0] == [0, 1, 2, 3]
+    # Level 1: rack groups.
+    assert gids[1] == [0, 0, 1, 1]
+    # Level 2: one zone.
+    assert gids[2] == [0, 0, 0, 0]

@@ -1,0 +1,233 @@
+"""Port of the reference's TestPlanNextMap golden cases (plan_test.go:392-1609).
+
+Each case fully specifies inputs and the exact expected map plus the total
+number of warnings.  Exact-match expectations are only possible because the
+planner is deterministic.
+"""
+
+import pytest
+
+from blance_tpu import Partition, PartitionModelState, PlanOptions, plan_next_map
+
+
+def pm(d):
+    """{"0": {"primary": ["a"]}} -> PartitionMap"""
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+def mdl(**states):
+    return {name: PartitionModelState(priority=pc[0], constraints=pc[1])
+            for name, pc in states.items()}
+
+
+M_1P_0R = mdl(primary=(0, 1), replica=(1, 0))
+M_1P_1R = mdl(primary=(0, 1), replica=(1, 1))
+M_2P_1R = mdl(primary=(0, 2), replica=(1, 1))
+
+EMPTY2 = {"0": {}, "1": {}}
+
+CASES = [
+    dict(
+        about="single node, simple assignment of primary",
+        prev={}, assign=EMPTY2, nodes=["a"], remove=[], add=["a"],
+        model=M_1P_0R,
+        exp={"0": {"primary": ["a"]}, "1": {"primary": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="single node, not enough to assign replicas",
+        prev={}, assign=EMPTY2, nodes=["a"], remove=[], add=["a"],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["a"], "replica": []},
+             "1": {"primary": ["a"], "replica": []}},
+        warnings=2,
+    ),
+    dict(
+        about="no partitions case",
+        prev={}, assign={}, nodes=["a"], remove=[], add=["a"],
+        model=M_1P_1R, exp={}, warnings=0,
+    ),
+    dict(
+        about="no model states case",
+        prev={}, assign=EMPTY2, nodes=["a"], remove=[], add=["a"],
+        model={}, exp={"0": {}, "1": {}}, warnings=0,
+    ),
+    dict(
+        about="2 nodes, enough for clean primary & replica",
+        prev={}, assign=EMPTY2, nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["a"], "replica": ["b"]},
+             "1": {"primary": ["b"], "replica": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="2 nodes, remove 1",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b"], remove=["b"], add=[],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["a"], "replica": []},
+             "1": {"primary": ["a"], "replica": []}},
+        warnings=2,
+    ),
+    dict(
+        about="2 nodes, remove 2",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b"], remove=["b", "a"], add=[],
+        model=M_1P_1R,
+        exp={"0": {"primary": [], "replica": []},
+             "1": {"primary": [], "replica": []}},
+        warnings=4,
+    ),
+    dict(
+        about="2 nodes, remove 3",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b", "c"], remove=["c", "b", "a"], add=[],
+        model=M_1P_1R,
+        exp={"0": {"primary": [], "replica": []},
+             "1": {"primary": [], "replica": []}},
+        warnings=4,
+    ),
+    dict(
+        about="2 nodes, nothing to add or remove",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign={"0": {"primary": ["a"], "replica": ["b"]},
+                "1": {"primary": ["b"], "replica": ["a"]}},
+        nodes=["a", "b", "c"], remove=[], add=[],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["a"], "replica": ["b"]},
+             "1": {"primary": ["b"], "replica": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="2 nodes, swap node a",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b", "c"], remove=["a"], add=["c"],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["c"], "replica": ["b"]},
+             "1": {"primary": ["b"], "replica": ["c"]}},
+        warnings=0,
+    ),
+    dict(
+        about="2 nodes, swap node b",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b", "c"], remove=["b"], add=["c"],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["a"], "replica": ["c"]},
+             "1": {"primary": ["c"], "replica": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="2 nodes, swap nodes a & b for c & d",
+        prev={"0": {"primary": ["a"], "replica": ["b"]},
+              "1": {"primary": ["b"], "replica": ["a"]}},
+        assign=EMPTY2, nodes=["a", "b", "c", "d"],
+        remove=["a", "b"], add=["c", "d"],
+        model=M_1P_1R,
+        exp={"0": {"primary": ["c"], "replica": ["d"]},
+             "1": {"primary": ["d"], "replica": ["c"]}},
+        warnings=0,
+    ),
+    dict(
+        about="add 2 nodes, 2 primaries, 1 replica",
+        prev={}, assign=EMPTY2, nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_2P_1R,
+        exp={"0": {"primary": ["a", "b"], "replica": []},
+             "1": {"primary": ["a", "b"], "replica": []}},
+        warnings=2,
+    ),
+    dict(
+        about="add 3 nodes, 2 primaries, 1 replica",
+        prev={}, assign=EMPTY2, nodes=["a", "b", "c"], remove=[],
+        add=["a", "b", "c"],
+        model=M_2P_1R,
+        exp={"0": {"primary": ["b", "a"], "replica": ["c"]},
+             "1": {"primary": ["c", "a"], "replica": ["b"]}},
+        warnings=0,
+    ),
+    dict(
+        about="model state constraint override",
+        prev={}, assign=EMPTY2, nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=mdl(primary=(0, 0), replica=(1, 0)),
+        constraints={"primary": 1, "replica": 1},
+        exp={"0": {"primary": ["a"], "replica": ["b"]},
+             "1": {"primary": ["b"], "replica": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="partition weight of 3 for partition 0",
+        prev={}, assign={str(i): {} for i in range(4)},
+        nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_0R, pweights={"0": 3},
+        exp={"0": {"primary": ["a"]}, "1": {"primary": ["b"]},
+             "2": {"primary": ["b"]}, "3": {"primary": ["b"]}},
+        warnings=0,
+    ),
+    dict(
+        about="partition weight of 3 for partition 0, with 4 partitions",
+        prev={}, assign={str(i): {} for i in range(5)},
+        nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_0R, pweights={"0": 3},
+        exp={"0": {"primary": ["a"]}, "1": {"primary": ["b"]},
+             "2": {"primary": ["b"]}, "3": {"primary": ["b"]},
+             "4": {"primary": ["a"]}},
+        warnings=0,
+    ),
+    dict(
+        about="partition weight of 3 for partition 1, with 5 partitions",
+        prev={}, assign={str(i): {} for i in range(6)},
+        nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_0R, pweights={"1": 3},
+        exp={"0": {"primary": ["b"]}, "1": {"primary": ["a"]},
+             "2": {"primary": ["b"]}, "3": {"primary": ["b"]},
+             "4": {"primary": ["a"]}, "5": {"primary": ["b"]}},
+        warnings=0,
+    ),
+    dict(
+        about="node weight of 3 for node a",
+        prev={}, assign={str(i): {} for i in range(6)},
+        nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_0R, nweights={"a": 3},
+        exp={"0": {"primary": ["a"]}, "1": {"primary": ["b"]},
+             "2": {"primary": ["a"]}, "3": {"primary": ["a"]},
+             "4": {"primary": ["a"]}, "5": {"primary": ["b"]}},
+        warnings=0,
+    ),
+    dict(
+        about="node weight of 3 for node b",
+        prev={}, assign={str(i): {} for i in range(6)},
+        nodes=["a", "b"], remove=[], add=["a", "b"],
+        model=M_1P_0R, nweights={"b": 3},
+        exp={"0": {"primary": ["a"]}, "1": {"primary": ["b"]},
+             "2": {"primary": ["b"]}, "3": {"primary": ["b"]},
+             "4": {"primary": ["a"]}, "5": {"primary": ["b"]}},
+        warnings=0,
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c["about"] for c in CASES])
+def test_plan_next_map(case):
+    opts = PlanOptions(
+        model_state_constraints=case.get("constraints"),
+        partition_weights=case.get("pweights"),
+        state_stickiness=case.get("sstick"),
+        node_weights=case.get("nweights"),
+        node_hierarchy=case.get("hierarchy"),
+        hierarchy_rules=case.get("rules"),
+    )
+    result, warnings = plan_next_map(
+        pm(case["prev"]), pm(case["assign"]), case["nodes"],
+        case["remove"], case["add"], case["model"], opts,
+    )
+    got = {name: p.nodes_by_state for name, p in result.items()}
+    exp = {name: dict(nbs) for name, nbs in case["exp"].items()}
+    assert got == exp, f"{case['about']}: got {got}, exp {exp}"
+    total = sum(len(w) for w in warnings.values())
+    assert total == case["warnings"], f"{case['about']}: warnings {warnings}"

@@ -1,0 +1,314 @@
+"""Port of TestPlanNextMapVis — flat-model golden scenarios
+(plan_test.go:1746-2205)."""
+
+from blance_tpu import model
+from blance_tpu.testing.vis import VisCase, run_vis_cases
+
+M_1P_0R = model(primary=(0, 1), replica=(1, 0))
+M_1P_1R = model(primary=(0, 1), replica=(1, 1))
+
+
+def test_plan_next_map_vis():
+    run_vis_cases([
+        VisCase(
+            about="single node, simple assignment of primary",
+            from_to=[("", "m"), ("", "m")],
+            nodes=["a"], nodes_to_add=["a"], model=M_1P_0R,
+        ),
+        VisCase(
+            about="added nodes a & b",
+            from_to=[("", "ms"), ("", "sm")],
+            nodes=["a", "b"], nodes_to_add=["a", "b"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="single node to 2 nodes",
+            from_to=[("m", "sm"), ("m", "ms")],
+            nodes=["a", "b"], nodes_to_add=["b"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="single node to 3 nodes",
+            from_to=[("m", "sm "), ("m", "m s")],
+            nodes=["a", "b", "c"], nodes_to_add=["b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="2 unbalanced nodes to balanced'ness",
+            from_to=[("ms", "sm"), ("ms", "ms")],
+            nodes=["a", "b"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="2 unbalanced nodes to 3 balanced nodes",
+            from_to=[("ms", " sm"), ("ms", "m s")],
+            nodes=["a", "b", "c"], nodes_to_add=["c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="4 partitions, 1 to 4 nodes",
+            from_to=[
+                ("m", "sm  "),
+                ("m", "  ms"),
+                ("m", "  sm"),
+                ("m", "ms  "),
+            ],
+            nodes=["a", "b", "c", "d"], nodes_to_add=["b", "c", "d"],
+            model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 1 to 4 nodes",
+            from_to=[
+                #      abcd
+                ("m", "sm  "),
+                ("m", "  ms"),
+                ("m", "s  m"),
+                ("m", " ms "),
+                ("m", "  ms"),
+                ("m", " s m"),
+                ("m", "ms  "),
+                ("m", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"], nodes_to_add=["b", "c", "d"],
+            model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 4 nodes don't change, 1 replica moved",
+            from_to=[
+                # abcd    abcd
+                ("sm  ", "sm  "),
+                ("  ms", "  ms"),
+                ("s  m", "s  m"),
+                (" ms ", " ms "),
+                (" sm ", "  ms"),  # Replica moved to d for balance.
+                (" s m", " s m"),
+                ("ms  ", "ms  "),
+                ("m s ", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 4 nodes don't change, so no changes",
+            from_to=[
+                ("sm  ", "sm  "),
+                ("  ms", "  ms"),
+                ("s  m", "s  m"),
+                (" ms ", " ms "),
+                ("  ms", "  ms"),
+                (" s m", " s m"),
+                ("ms  ", "ms  "),
+                ("m s ", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="single node swap, from node b to node e",
+            from_to=[
+                # abcd    abcde
+                (" m s", "   sm"),
+                ("  ms", "  ms "),
+                ("s  m", "s  m "),
+                (" ms ", "  s m"),
+                (" sm ", "  m s"),
+                ("s  m", "s  m "),
+                ("ms  ", "m   s"),
+                ("m s ", "m s  "),
+            ],
+            nodes=["a", "b", "c", "d", "e"],
+            nodes_to_remove=["b"], nodes_to_add=["e"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="4 nodes to 3 nodes, remove node d",
+            from_to=[
+                # abcd    abc
+                (" m s", "sm "),
+                ("  ms", "s m"),
+                ("s  m", "m s"),
+                (" ms ", " ms"),
+                (" sm ", " sm"),
+                ("s  m", "sm "),
+                ("ms  ", "ms "),
+                ("m s ", "m s"),
+            ],
+            nodes=["a", "b", "c", "d"], nodes_to_remove=["d"], model=M_1P_1R,
+        ),
+        VisCase(
+            ignore=True,  # Known gap carried from the reference
+            # (plan_test.go:1949-1971): shrinking constraints does not clear
+            # stale replicas.
+            about="change constraints from 1 replica to 0 replicas",
+            from_to=[
+                (" m s", " m  "),
+                ("  ms", "  m "),
+                ("s  m", "   m"),
+                (" ms ", " m  "),
+                (" sm ", "  m "),
+                ("s  m", "   m"),
+                ("ms  ", "m   "),
+                ("m s ", "m   "),
+            ],
+            nodes=["a", "b", "c", "d"], model=M_1P_0R,
+        ),
+        VisCase(
+            about="8 partitions, 1 to 8 nodes",
+            from_to=[
+                #      abcdefgh
+                ("m", "sm      "),
+                ("m", "  ms    "),
+                ("m", "  sm    "),
+                ("m", "    ms  "),
+                ("m", "    sm  "),
+                ("m", "      ms"),
+                ("m", "      sm"),
+                ("m", "ms      "),
+            ],
+            nodes=list("abcdefgh"), nodes_to_add=list("bcdefgh"),
+            model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 1 to 8 nodes, 0 replicas",
+            from_to=[
+                ("m", " m      "),
+                ("m", "  m     "),
+                ("m", "   m    "),
+                ("m", "    m   "),
+                ("m", "     m  "),
+                ("m", "      m "),
+                ("m", "       m"),
+                ("m", "m       "),
+            ],
+            nodes=list("abcdefgh"), nodes_to_add=list("bcdefgh"),
+            model=M_1P_0R,
+        ),
+        VisCase(
+            about="8 partitions, 4 nodes, increase partition 000 weight",
+            from_to=[
+                # abcd    abcd
+                ("sm  ", " m s"),
+                ("  ms", "s m "),
+                ("s  m", "s  m"),
+                (" ms ", "  sm"),
+                (" sm ", " sm "),
+                (" s m", " s m"),
+                ("ms  ", "ms  "),
+                ("m s ", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"],
+            partition_weights={"000": 100}, model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 4 nodes, increase partition 004 weight",
+            from_to=[
+                ("sm  ", "sm  "),
+                ("  ms", "s  m"),
+                ("s  m", "s  m"),
+                (" ms ", " ms "),
+                (" sm ", "  ms"),
+                (" s m", " s m"),
+                ("ms  ", "ms  "),
+                ("m s ", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"],
+            partition_weights={"004": 100}, model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 4 nodes, increase partition 000, 004 weight",
+            from_to=[
+                ("sm  ", " m s"),  # partition 000.
+                ("  ms", " s m"),
+                ("s  m", "  sm"),
+                (" ms ", "m s "),
+                (" sm ", "s m "),  # partition 004.
+                (" s m", " s m"),
+                ("ms  ", "ms  "),
+                ("m s ", "m s "),
+            ],
+            nodes=["a", "b", "c", "d"],
+            partition_weights={"000": 100, "004": 100}, model=M_1P_1R,
+        ),
+        VisCase(
+            about="4 nodes to 3 nodes, remove node d, high stickiness",
+            from_to=[
+                (" m s", "sm "),
+                ("  ms", "s m"),
+                ("s  m", "m s"),
+                (" ms ", " ms"),
+                (" sm ", " sm"),
+                ("s  m", "sm "),
+                ("ms  ", "ms "),
+                ("m s ", "m s"),
+            ],
+            nodes=["a", "b", "c", "d"], nodes_to_remove=["d"],
+            state_stickiness={"primary": 1000000}, model=M_1P_1R,
+        ),
+        VisCase(
+            about="3 partitions, 2 nodes add 1 node, sm first",
+            from_to=[
+                # ab    abc
+                ("sm", "s m"),
+                ("ms", "ms "),
+                ("sm", " ms"),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="3 partitions, 2 nodes add 1 node, ms first",
+            from_to=[
+                ("ms", " sm"),
+                ("sm", "sm "),
+                ("ms", "m s"),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 2 nodes add 1 node",
+            from_to=[
+                ("sm", "s m"),
+                ("sm", "s m"),
+                ("sm", " ms"),
+                ("sm", " ms"),
+                ("ms", "s m"),
+                ("ms", "ms "),
+                ("ms", "ms "),
+                ("ms", "ms "),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 2 nodes add 1 node, flipped ms",
+            from_to=[
+                ("ms", " sm"),
+                ("ms", " sm"),
+                ("ms", "m s"),
+                ("ms", "m s"),
+                ("sm", " sm"),
+                ("sm", "sm "),
+                ("sm", "sm "),
+                ("sm", "sm "),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 2 nodes add 1 node, interleaved m's",
+            from_to=[
+                ("ms", " sm"),
+                ("sm", "s m"),
+                ("ms", "m s"),
+                ("sm", " ms"),
+                ("ms", "ms "),
+                ("sm", "sm "),
+                ("ms", "ms "),
+                ("sm", "sm "),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+        VisCase(
+            about="8 partitions, 2 nodes add 1 node, interleaved s'm",
+            from_to=[
+                ("sm", "s m"),
+                ("ms", " sm"),
+                ("sm", " ms"),
+                ("ms", "m s"),
+                ("sm", "sm "),
+                ("ms", "ms "),
+                ("sm", "sm "),
+                ("ms", "ms "),
+            ],
+            nodes=["a", "b", "c"], model=M_1P_1R,
+        ),
+    ])

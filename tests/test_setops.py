@@ -1,0 +1,95 @@
+"""Ports of the reference's string-set op tests (misc_test.go, plan_test.go units)."""
+
+from blance_tpu import (
+    Partition,
+    count_state_nodes,
+    flatten_nodes_by_state,
+    model,
+    sort_state_names,
+    strings_dedup,
+    strings_intersect,
+    strings_remove,
+    strings_to_set,
+)
+from blance_tpu.plan.greedy import _remove_nodes_from_nodes_by_state
+
+
+def test_strings_to_set():
+    assert strings_to_set(None) is None
+    assert strings_to_set([]) == set()
+    assert strings_to_set(["a"]) == {"a"}
+    assert strings_to_set(["a", "a", "b"]) == {"a", "b"}
+
+
+def test_strings_remove():
+    assert strings_remove([], []) == []
+    assert strings_remove(["a"], []) == ["a"]
+    assert strings_remove(["a"], ["a"]) == []
+    assert strings_remove(["a", "b", "a"], ["a"]) == ["b"]
+    assert strings_remove(["a", "b", "a"], ["b"]) == ["a", "a"]
+    assert strings_remove(["a", "b", "c"], ["b", "x"]) == ["a", "c"]
+    assert strings_remove(["a", "b", "c"], None) == ["a", "b", "c"]
+
+
+def test_strings_intersect():
+    assert strings_intersect([], []) == []
+    assert strings_intersect(["a"], []) == []
+    assert strings_intersect([], ["a"]) == []
+    assert strings_intersect(["a"], ["a"]) == ["a"]
+    assert strings_intersect(["a", "b"], ["b", "c"]) == ["b"]
+    # Order follows the first array; result is deduplicated.
+    assert strings_intersect(["b", "a", "b"], ["b", "a"]) == ["b", "a"]
+    assert strings_intersect(["a", "b"], None) == []
+
+
+def test_strings_dedup():
+    assert strings_dedup([]) == []
+    assert strings_dedup(["a", "a"]) == ["a"]
+    assert strings_dedup(["b", "a", "b", "c"]) == ["b", "a", "c"]
+
+
+def test_flatten_nodes_by_state():
+    assert flatten_nodes_by_state({}) == []
+    assert flatten_nodes_by_state({"primary": []}) == []
+    assert flatten_nodes_by_state({"primary": ["a", "b"]}) == ["a", "b"]
+    assert flatten_nodes_by_state({"primary": ["a", "b"], "replica": ["c"]}) == [
+        "a", "b", "c",
+    ]
+
+
+def test_remove_nodes_from_nodes_by_state():
+    cases = [
+        ({"primary": ["a", "b"]}, ["a", "b"], {"primary": []}),
+        ({"primary": ["a", "b"]}, ["b", "c"], {"primary": ["a"]}),
+        ({"primary": ["a", "b"]}, ["a", "c"], {"primary": ["b"]}),
+        ({"primary": ["a", "b"]}, [], {"primary": ["a", "b"]}),
+        (
+            {"primary": ["a", "b"], "replica": ["c"]},
+            ["a", "c"],
+            {"primary": ["b"], "replica": []},
+        ),
+    ]
+    for nbs, remove, exp in cases:
+        assert _remove_nodes_from_nodes_by_state(nbs, remove) == exp
+
+
+def test_sort_state_names():
+    m = model(primary=(0, 1), replica=(1, 1))
+    assert sort_state_names(m) == ["primary", "replica"]
+    m2 = model(a=(1, 1), b=(0, 1), c=(0, 1))
+    assert sort_state_names(m2) == ["b", "c", "a"]
+
+
+def test_count_state_nodes():
+    m = {
+        "0": Partition("0", {"primary": ["a"], "replica": ["b", "c"]}),
+        "1": Partition("1", {"primary": ["b"], "replica": ["c"]}),
+    }
+    assert count_state_nodes(m, None) == {
+        "primary": {"a": 1, "b": 1},
+        "replica": {"b": 1, "c": 2},
+    }
+    assert count_state_nodes(m, {"0": 2}) == {
+        "primary": {"a": 2, "b": 1},
+        "replica": {"b": 2, "c": 3},
+    }
