@@ -1,0 +1,92 @@
+"""Port of the reference's placement-control contract tests
+(control_test.go:18-416): the node-score-booster hook plus negative node
+weights let applications pin or steer placements.  In blance_tpu the booster
+is a PlanOptions field, not a package global."""
+
+from blance_tpu import HierarchyRule, Partition, PlanOptions, model, plan_next_map
+
+
+def cbgt_booster(w: int, stickiness: float) -> float:
+    """The booster couchbase/cbgt installs (control_test.go:19-29)."""
+    return max(float(-w), stickiness)
+
+
+M = model(primary=(0, 1), replica=(1, 1))
+
+
+def nbs(result):
+    return {name: p.nodes_by_state for name, p in result.items()}
+
+
+def test_control_case1_pin_primary_to_c_replica_to_b():
+    parts = {"X": Partition("X", {})}
+    r, warnings = plan_next_map(
+        {}, parts, ["a", "b", "c", "d", "e"], None, None, M,
+        PlanOptions(
+            node_weights={"a": -2, "b": -1, "d": -2, "e": -2},
+            node_score_booster=cbgt_booster,
+        ),
+    )
+    assert not warnings
+    assert nbs(r) == {"X": {"primary": ["c"], "replica": ["b"]}}
+
+
+def test_control_case2_no_relocation_on_node_add():
+    parts = {
+        "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
+        "Y": Partition("Y", {"primary": ["b"], "replica": ["a"]}),
+        "Z": Partition("Z", {"primary": ["a"], "replica": ["b"]}),
+    }
+    r, warnings = plan_next_map(
+        {}, parts, ["a", "b"], None, ["c"], M,
+        PlanOptions(node_score_booster=cbgt_booster),
+    )
+    assert not warnings
+    assert nbs(r) == {
+        "X": {"primary": ["a"], "replica": ["b"]},
+        "Y": {"primary": ["b"], "replica": ["a"]},
+        "Z": {"primary": ["a"], "replica": ["b"]},
+    }
+
+
+def test_control_case3_steer_new_partition():
+    parts = {
+        "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
+        "Y": Partition("Y", {"primary": ["b"], "replica": ["a"]}),
+        "Z": Partition("Z", {}),
+    }
+    r, warnings = plan_next_map(
+        {}, parts, ["a", "b", "c"], None, None, M,
+        PlanOptions(
+            node_weights={"c": -3, "a": -1},
+            node_score_booster=cbgt_booster,
+        ),
+    )
+    assert not warnings
+    assert nbs(r) == {
+        "X": {"primary": ["a"], "replica": ["b"]},
+        "Y": {"primary": ["b"], "replica": ["a"]},
+        "Z": {"primary": ["b"], "replica": ["a"]},
+    }
+
+
+def test_control_case4_hierarchy_plus_booster():
+    prev = {"X": Partition("X", {"primary": ["a"], "replica": ["b"]})}
+    parts = {
+        "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
+        "Y": Partition("Y", {}),
+    }
+    r, warnings = plan_next_map(
+        prev, parts, ["a", "b"], None, None, M,
+        PlanOptions(
+            node_weights={"a": -1, "b": -1},
+            node_hierarchy={"a": "Group 1", "b": "Group 2"},
+            hierarchy_rules={"replica": [HierarchyRule(2, 1)]},
+            node_score_booster=cbgt_booster,
+        ),
+    )
+    assert not warnings
+    assert nbs(r) == {
+        "X": {"primary": ["a"], "replica": ["b"]},
+        "Y": {"primary": ["b"], "replica": ["a"]},
+    }

@@ -1,0 +1,138 @@
+"""Port of the reference's move-calculus tests (moves_test.go:19-517),
+including the before/moves/after ASCII mini-DSL and its flip-side verifier."""
+
+from blance_tpu import calc_partition_moves
+from blance_tpu.moves.calc import _find_state_changes
+
+STATES = ["primary", "replica"]
+
+
+def line_to_nodes_by_state(line, states):
+    """' a b | +c -d' -> {'primary': ['a','b'], 'replica': ['+c','-d']}
+    (moves_test.go:491-517)."""
+    line = " ".join(line.split())
+    parts = line.split("|")
+    nbs = {}
+    for i, state in enumerate(states):
+        if i >= len(parts):
+            break
+        part = parts[i].strip()
+        if part:
+            nbs.setdefault(state, []).extend(part.split(" "))
+    return nbs
+
+
+def test_find_state_changes():
+    cases = [
+        (0, 0, "primary", {"primary": ["a"], "replica": ["b", "c"]},
+         {"primary": ["a"], "replica": ["b", "c"]}, []),
+        (1, 2, "primary", {"primary": ["a"], "replica": ["b", "c"]},
+         {"primary": ["a"], "replica": ["b", "c"]}, []),
+        (0, 0, "primary", {"primary": [], "replica": ["a"]},
+         {"primary": ["a"], "replica": []}, []),
+        (1, 2, "primary", {"primary": [], "replica": ["a"]},
+         {"primary": ["a"], "replica": []}, ["a"]),
+        (0, 1, "replica", {"primary": ["a"], "replica": []},
+         {"primary": [], "replica": ["a"]}, ["a"]),
+        (1, 2, "replica", {"primary": ["a"], "replica": []},
+         {"primary": [], "replica": ["a"]}, []),
+        (1, 2, "replica", {"primary": [], "replica": ["a"]},
+         {"primary": [], "replica": []}, []),
+        (1, 2, "primary", {"primary": ["a"], "replica": ["b", "c", "d"]},
+         {"primary": ["b"], "replica": ["a", "c", "d"]}, ["b"]),
+        (1, 2, "primary", {"primary": ["a"], "replica": ["b", "c", "d"]},
+         {"primary": ["x"], "replica": ["a", "c", "d"]}, []),
+    ]
+    for beg_idx, end_idx, state, beg, end, exp in cases:
+        assert _find_state_changes(beg_idx, end_idx, state, STATES, beg, end) == exp
+
+
+# (before, moves, after, favor_min_nodes) — moves_test.go:151-360.
+CASES = [
+    (" a", "", " a", False),
+    (" a", "", " a", True),
+    ("      | a", "", "      | a", False),
+    ("      | a", "", "      | a", True),
+    (" a    | b", "", " a    | b", False),
+    (" a    | b", "", " a    | b", True),  # Test #5
+    ("", "+a", " a", False),
+    ("", "+a", " a", True),
+    (" a", "-a", "", False),
+    (" a", "-a", "", True),
+    ("", "+a    |\n a    |+b", " a    | b", False),  # Test #10
+    ("", "      |+b\n +a    | b", " a    | b", True),
+    (" a    | b", " a    |-b", " a", False),
+    (" a    | b", " a    |-b", " a", True),
+    (" a    | b", "-a    | b", "      | b", False),
+    (" a    | b", "-a    | b", "      | b", True),  # Test #15
+    (" a    | b", "-a    | b\n       |-b", "", False),
+    (" a    | b", " a    |-b\n -a    |", "", True),
+    (" a", " a +b |\n -a  b |", "    b", False),
+    (" a", "-a    |\n    +b |", "    b", True),
+    (" a    | b  c", " a +b |-b  c\n -a  b |    c\n     b |    c +d",
+     "    b |    c  d", False),  # Test #20
+    (" a    | b  c", " a    | b  c +d\n -a    | b  c  d\n    +b |-b  c  d",
+     "    b |    c  d", True),
+    (" a    |    b", " a +b |   -b\n -a  b |+a", "    b | a", False),
+    (" a    |    b", "-a    |+a  b\n    +b | a -b", "    b | a", True),
+    (" a    |    b", " a +c |    b\n -a  c |+a  b\n     c | a -b",
+     "    c | a", False),
+    (" a    |    b", " a    |   -b\n -a    |+a\n    +c | a",
+     "    c | a", True),  # Test #25
+    (" a    | b", " a +c | b\n -a  c | b\n     c | b +d\n     c |-b  d",
+     "    c |    d", False),
+    (" a    | b", " a    |-b\n  a    |   +d\n -a    |    d\n    +c |    d",
+     "    c |    d", True),
+    (" a    |    b", "-a    |+a  b\n       | a  b +c", "      | a  b  c", False),
+]
+
+_NEGATE = {"+": "-", "-": "+"}
+_OPS = {"+": "add", "-": "del"}
+
+
+def test_calc_partition_moves():
+    for testi, (before_s, moves_s, after_s, favor_min) in enumerate(CASES):
+        before = line_to_nodes_by_state(before_s, STATES)
+        after = line_to_nodes_by_state(after_s, STATES)
+
+        moves_exp = []
+        if moves_s != "":
+            for move_line in moves_s.split("\n"):
+                moves_exp.append(line_to_nodes_by_state(move_line, STATES))
+
+        moves_got = calc_partition_moves(STATES, before, after, favor_min)
+        assert len(moves_got) == len(moves_exp), (
+            f"test {testi}: got {moves_got}, exp {moves_exp}")
+
+        # The flip-side verifier (moves_test.go:397-484): each expected move
+        # line has exactly one +x/-x token; if the opposite token appears in a
+        # lower state on the same line, the op is a promote/demote.
+        for i, move_exp in enumerate(moves_exp):
+            got = moves_got[i]
+            found = False
+            for statei, state in enumerate(STATES):
+                if found:
+                    continue
+                for move in move_exp.get(state, []):
+                    if found:
+                        continue
+                    op = move[0:1]
+                    if op in ("+", "-"):
+                        found = True
+                        assert got.node == move[1:], (
+                            f"test {testi} move {i}: node {got} vs {move}")
+                        flip = _NEGATE[op] + move[1:]
+                        flip_state = ""
+                        for j in range(statei + 1, len(STATES)):
+                            if flip in move_exp.get(STATES[j], []):
+                                flip_state = STATES[j]
+                        if flip_state:
+                            state_exp = flip_state if op == "-" else state
+                            assert got.op in ("promote", "demote"), (
+                                f"test {testi} move {i}: {got}")
+                        else:
+                            state_exp = "" if op == "-" else state
+                            assert got.op == _OPS[op], (
+                                f"test {testi} move {i}: {got}")
+                        assert got.state == state_exp, (
+                            f"test {testi} move {i}: {got}, exp state {state_exp!r}")
