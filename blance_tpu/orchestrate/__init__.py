@@ -1,1 +1,33 @@
-"""blance_tpu.orchestrate subpackage."""
+"""blance_tpu.orchestrate — asyncio rebalance control plane."""
+
+from .csp import GET, PUT, Chan, ChanClosed, select
+from .orchestrator import (
+    MOVE_OP_WEIGHT,
+    ErrorInterrupt,
+    ErrorStopped,
+    NextMoves,
+    Orchestrator,
+    OrchestratorOptions,
+    OrchestratorProgress,
+    PartitionMove,
+    lowest_weight_partition_move_for_node,
+    orchestrate_moves,
+)
+
+__all__ = [
+    "GET",
+    "PUT",
+    "Chan",
+    "ChanClosed",
+    "select",
+    "MOVE_OP_WEIGHT",
+    "ErrorInterrupt",
+    "ErrorStopped",
+    "NextMoves",
+    "Orchestrator",
+    "OrchestratorOptions",
+    "OrchestratorProgress",
+    "PartitionMove",
+    "lowest_weight_partition_move_for_node",
+    "orchestrate_moves",
+]

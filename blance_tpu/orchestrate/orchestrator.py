@@ -1,0 +1,556 @@
+"""Rebalance orchestrator: executes map-to-map transitions cluster-wide.
+
+Reimplements the reference's control plane (reference: /root/reference/
+orchestrate.go:80-763) on asyncio: one mover task per node, a supplier task
+running broadcast rounds, per-node concurrency limits, app-controlled move
+prioritization, pause/resume/stop, and a blocking progress stream.
+
+Round structure (orchestrate.go:509-618): each round groups every
+partition's *current* move by destination node, spawns one feeder per node
+with that node's best k moves, and the FIRST successful feed interrupts all
+other feeders so availability is recomputed — this keeps the whole cluster's
+choices fresh as work completes.  A feeder that finds its batch already
+in-flight waits on that move instead of double-feeding
+(orchestrate.go:622-696).
+
+The app's assign_partitions callback is the only data plane — the
+orchestrator never moves bytes itself, so it is transport-agnostic by
+construction (orchestrate.go:148-152).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from dataclasses import dataclass, field, replace
+from typing import Awaitable, Callable, Optional, Union
+
+from ..core.types import PartitionMap, PartitionModel
+from ..moves.calc import calc_partition_moves
+from ..plan.greedy import sort_state_names
+from .csp import Chan, select, GET, PUT
+
+__all__ = [
+    "ErrorStopped",
+    "ErrorInterrupt",
+    "Orchestrator",
+    "OrchestratorOptions",
+    "OrchestratorProgress",
+    "PartitionMove",
+    "NextMoves",
+    "MOVE_OP_WEIGHT",
+    "lowest_weight_partition_move_for_node",
+    "orchestrate_moves",
+]
+
+
+class StoppedError(Exception):
+    """The operation was stopped (reference orchestrate.go:18)."""
+
+
+class InterruptError(Exception):
+    """The operation was interrupted by a broadcast (orchestrate.go:21)."""
+
+
+# Sentinel singletons, compared by identity like the reference's error vars.
+ErrorStopped = StoppedError("stopped")
+ErrorInterrupt = InterruptError("interrupt")
+
+
+@dataclass
+class OrchestratorOptions:
+    """Advanced config (orchestrate.go:110-115)."""
+
+    # <= 0 is treated as 1 (orchestrate.go:484-487).
+    max_concurrent_partition_moves_per_node: int = 1
+    favor_min_nodes: bool = False
+
+
+@dataclass
+class OrchestratorProgress:
+    """Monotonic progress counters + errors, streamed as whole snapshots
+    (orchestrate.go:119-141)."""
+
+    errors: list = field(default_factory=list)
+
+    tot_stop: int = 0
+    tot_pause_new_assignments: int = 0
+    tot_resume_new_assignments: int = 0
+    tot_run_mover: int = 0
+    tot_run_mover_done: int = 0
+    tot_run_mover_done_err: int = 0
+    tot_mover_loop: int = 0
+    tot_mover_assign_partition: int = 0
+    tot_mover_assign_partition_ok: int = 0
+    tot_mover_assign_partition_err: int = 0
+    tot_run_supply_moves_loop: int = 0
+    tot_run_supply_moves_loop_done: int = 0
+    tot_run_supply_moves_feeding: int = 0
+    tot_run_supply_moves_feeding_done: int = 0
+    tot_run_supply_moves_done: int = 0
+    tot_run_supply_moves_done_err: int = 0
+    tot_run_supply_moves_pause: int = 0
+    tot_run_supply_moves_resume: int = 0
+    tot_progress_close: int = 0
+
+    def snapshot(self) -> "OrchestratorProgress":
+        return replace(self, errors=list(self.errors))
+
+
+@dataclass(frozen=True)
+class PartitionMove:
+    """A state change/op for one partition on one node (orchestrate.go:162-172)."""
+
+    partition: str
+    node: str
+    state: str  # "" means removal
+    op: str  # "add" | "del" | "promote" | "demote"
+
+
+MOVE_OP_WEIGHT = {"promote": 1, "demote": 2, "add": 3, "del": 4}
+
+
+def lowest_weight_partition_move_for_node(
+    node: str, moves: list[PartitionMove]
+) -> int:
+    """Default FindMoveFunc: index of the lightest op (orchestrate.go:177-186).
+
+    First-lowest wins ties, so single-node promotions/demotions go first and
+    clients regain coverage quickly.
+    """
+    r = 0
+    for i, move in enumerate(moves):
+        if MOVE_OP_WEIGHT.get(moves[r].op, 0) > MOVE_OP_WEIGHT.get(move.op, 0):
+            r = i
+    return r
+
+
+class NextMoves:
+    """Cursor over one partition's immutable move sequence
+    (orchestrate.go:198-214)."""
+
+    __slots__ = ("partition", "next", "moves", "next_done_ch")
+
+    def __init__(self, partition: str, moves: list) -> None:
+        self.partition = partition
+        self.next = 0  # index of the next available move
+        self.moves = moves
+        # Non-None while the current move is in flight; == the feeding
+        # request's done channel.
+        self.next_done_ch: Optional[Chan] = None
+
+
+class _PartitionMoveReq:
+    """A batch of moves for one node + completion channel (orchestrate.go:220-223)."""
+
+    __slots__ = ("partition_moves", "done_ch")
+
+    def __init__(self, partition_moves: list[PartitionMove], done_ch: Chan) -> None:
+        self.partition_moves = partition_moves
+        self.done_ch = done_ch
+
+
+AssignPartitionsFunc = Callable[..., Union[Optional[Exception], Awaitable]]
+FindMoveFunc = Callable[[str, list[PartitionMove]], int]
+
+
+class Orchestrator:
+    """Runtime state of one orchestrate_moves() run (orchestrate.go:80-106)."""
+
+    def __init__(
+        self,
+        model: PartitionModel,
+        options: OrchestratorOptions,
+        nodes_all: list[str],
+        beg_map: PartitionMap,
+        end_map: PartitionMap,
+        assign_partitions: AssignPartitionsFunc,
+        find_move: Optional[FindMoveFunc],
+        map_partition_to_next_moves: dict[str, NextMoves],
+    ) -> None:
+        self.model = model
+        self.options = options
+        self.nodes_all = nodes_all
+        self.beg_map = beg_map
+        self.end_map = end_map
+        self._assign_partitions = assign_partitions
+        self._find_move = find_move or lowest_weight_partition_move_for_node
+
+        self._progress_ch = Chan()
+        self._map_node_to_req_ch = {node: Chan() for node in nodes_all}
+
+        self._stop_ch: Optional[Chan] = Chan()
+        self._pause_ch: Optional[Chan] = None
+        self._progress = OrchestratorProgress()
+        self._map_partition_to_next_moves = map_partition_to_next_moves
+
+        self._tasks: list[asyncio.Task] = []
+
+    # -- public control surface ---------------------------------------------
+
+    def progress_ch(self) -> Chan:
+        """Progress snapshot stream; MUST be drained until close or the
+        orchestration wedges (documented requirement, orchestrate.go:230-232).
+        Iterate with ``async for``."""
+        return self._progress_ch
+
+    def stop(self) -> None:
+        """Idempotent async stop; the progress channel eventually closes
+        (orchestrate.go:342-350)."""
+        if self._stop_ch is not None:
+            self._progress.tot_stop += 1
+            self._stop_ch.close()
+            self._stop_ch = None
+
+    def pause_new_assignments(self) -> None:
+        """Stop starting new assignments; in-flight moves finish.  Idempotent
+        (orchestrate.go:367-375)."""
+        if self._pause_ch is None:
+            self._pause_ch = Chan()
+            self._progress.tot_pause_new_assignments += 1
+
+    def resume_new_assignments(self) -> None:
+        """Idempotent resume (orchestrate.go:379-388)."""
+        if self._pause_ch is not None:
+            self._progress.tot_resume_new_assignments += 1
+            self._pause_ch.close()
+            self._pause_ch = None
+
+    def visit_next_moves(self, cb) -> None:
+        """Read access to the live move cursors, e.g. for UIs
+        (orchestrate.go:395-399)."""
+        cb(self._map_partition_to_next_moves)
+
+    # -- internals -----------------------------------------------------------
+
+    def _start(self, stop_ch: Chan) -> None:
+        run_mover_done_ch = Chan()
+        for node in self.nodes_all:
+            self._tasks.append(
+                asyncio.ensure_future(
+                    self._run_mover(stop_ch, run_mover_done_ch, node)
+                )
+            )
+        self._tasks.append(
+            asyncio.ensure_future(
+                self._run_supply_moves(stop_ch, run_mover_done_ch)
+            )
+        )
+
+    async def _update_progress(self, mutate) -> None:
+        """Apply a counter mutation and blocking-send a snapshot
+        (orchestrate.go:735-745)."""
+        mutate()
+        await self._progress_ch.put(self._progress.snapshot())
+
+    async def _call_assign(self, stop_ch, node, partitions, states, ops):
+        """Invoke the app callback (sync or async); exceptions become the
+        move's error."""
+        try:
+            result = self._assign_partitions(stop_ch, node, partitions, states, ops)
+            if inspect.isawaitable(result):
+                result = await result
+        except Exception as exc:  # app errors flow into progress.errors
+            return exc
+        return result if isinstance(result, Exception) else None
+
+    async def _run_mover(self, stop_ch: Chan, done_ch: Chan, node: str) -> None:
+        await self._update_progress(
+            lambda: setattr(self._progress, "tot_run_mover",
+                            self._progress.tot_run_mover + 1))
+        err = await self._mover_loop(stop_ch, self._map_node_to_req_ch[node], node)
+        await done_ch.put(err)
+
+    async def _mover_loop(self, stop_ch: Chan, req_ch: Chan, node: str):
+        """Receive batched move requests and run the assign callback
+        synchronously per batch (orchestrate.go:426-480)."""
+        while True:
+            await self._update_progress(
+                lambda: setattr(self._progress, "tot_mover_loop",
+                                self._progress.tot_mover_loop + 1))
+
+            which, value = await select((GET, stop_ch), (GET, req_ch))
+            if which == 0:
+                return None
+            req, ok = value
+            if not ok:
+                return None
+
+            partitions = [pm.partition for pm in req.partition_moves]
+            states = [pm.state for pm in req.partition_moves]
+            ops = [pm.op for pm in req.partition_moves]
+
+            await self._update_progress(
+                lambda: setattr(self._progress, "tot_mover_assign_partition",
+                                self._progress.tot_mover_assign_partition + 1))
+
+            err = await self._call_assign(stop_ch, node, partitions, states, ops)
+
+            def count():
+                if err is not None:
+                    self._progress.tot_mover_assign_partition_err += 1
+                else:
+                    self._progress.tot_mover_assign_partition_ok += 1
+            await self._update_progress(count)
+
+            if req.done_ch is not None:
+                if err is not None:
+                    await select((GET, stop_ch), (PUT, req.done_ch, err))
+                req.done_ch.close()
+
+    def _filter_next_plausible_moves_for_node(
+        self, node: str, next_moves_arr: list[NextMoves]
+    ) -> list[NextMoves]:
+        """Pick up to max_concurrent best moves via the app's find_move
+        (orchestrate.go:482-504)."""
+        count = self.options.max_concurrent_partition_moves_per_node
+        if count <= 0:
+            count = 1
+        count = min(count, len(next_moves_arr))
+
+        arr = list(next_moves_arr)
+        picked: list[NextMoves] = []
+        while count > 0:
+            i = self._find_next_moves(node, arr)
+            picked.append(arr[i])
+            count -= 1
+            arr[i] = arr[-1]
+            arr.pop()
+        return picked
+
+    def _find_next_moves(self, node: str, next_moves_arr: list[NextMoves]) -> int:
+        """Ask the app which available move to do next (orchestrate.go:699-714)."""
+        moves = [
+            PartitionMove(
+                partition=nm.partition,
+                node=nm.moves[nm.next].node,
+                state=nm.moves[nm.next].state,
+                op=nm.moves[nm.next].op,
+            )
+            for nm in next_moves_arr
+        ]
+        return self._find_move(node, moves)
+
+    def _find_available_moves(self) -> dict[str, list[NextMoves]]:
+        """Group each partition's current move by destination node
+        (orchestrate.go:749-763)."""
+        available: dict[str, list[NextMoves]] = {}
+        for nm in self._map_partition_to_next_moves.values():
+            if nm.next < len(nm.moves):
+                available.setdefault(nm.moves[nm.next].node, []).append(nm)
+        return available
+
+    async def _run_supply_moves(self, stop_ch: Chan, run_mover_done_ch: Chan) -> None:
+        """The round loop (orchestrate.go:509-618)."""
+        err_outer = None
+
+        while err_outer is None:
+            await self._update_progress(
+                lambda: setattr(self._progress, "tot_run_supply_moves_loop",
+                                self._progress.tot_run_supply_moves_loop + 1))
+
+            available = self._find_available_moves()
+            pause_ch = self._pause_ch
+
+            if not available:
+                break
+
+            # Pause blocks the whole supplier between rounds; Stop() while
+            # paused requires a resume first (orchestrate.go:531-544).
+            if pause_ch is not None:
+                await self._update_progress(
+                    lambda: setattr(self._progress, "tot_run_supply_moves_pause",
+                                    self._progress.tot_run_supply_moves_pause + 1))
+                await pause_ch.get()
+                await self._update_progress(
+                    lambda: setattr(self._progress, "tot_run_supply_moves_resume",
+                                    self._progress.tot_run_supply_moves_resume + 1))
+
+            broadcast_stop_ch = Chan()
+            broadcast_done_ch = Chan()
+
+            for node, next_moves_arr in available.items():
+                picked = self._filter_next_plausible_moves_for_node(
+                    node, next_moves_arr)
+                self._tasks.append(asyncio.ensure_future(self._run_supply_move(
+                    stop_ch, node, picked, broadcast_stop_ch, broadcast_done_ch)))
+
+            await self._update_progress(
+                lambda: setattr(self._progress, "tot_run_supply_moves_feeding",
+                                self._progress.tot_run_supply_moves_feeding + 1))
+
+            # First successful feed interrupts the other feeders so the next
+            # round recomputes availability (orchestrate.go:566-580).
+            broadcast_stopped = False
+            for _ in range(len(available)):
+                err, _ok = await broadcast_done_ch.get()
+                if err is None and not broadcast_stopped:
+                    broadcast_stop_ch.close()
+                    broadcast_stopped = True
+                if err is not None and err is not ErrorInterrupt and err_outer is None:
+                    err_outer = err
+
+            await self._update_progress(
+                lambda: setattr(self._progress, "tot_run_supply_moves_feeding_done",
+                                self._progress.tot_run_supply_moves_feeding_done + 1))
+
+            if not broadcast_stopped:
+                broadcast_stop_ch.close()
+            broadcast_done_ch.close()
+
+        await self._update_progress(
+            lambda: setattr(self._progress, "tot_run_supply_moves_loop_done",
+                            self._progress.tot_run_supply_moves_loop_done + 1))
+
+        for req_ch in self._map_node_to_req_ch.values():
+            req_ch.close()
+
+        def count_done():
+            self._progress.tot_run_supply_moves_done += 1
+            if err_outer is not None and err_outer is not ErrorStopped:
+                self._progress.errors.append(err_outer)
+                self._progress.tot_run_supply_moves_done_err += 1
+        await self._update_progress(count_done)
+
+        await self._wait_for_all_movers_done(run_mover_done_ch)
+
+        await self._update_progress(
+            lambda: setattr(self._progress, "tot_progress_close",
+                            self._progress.tot_progress_close + 1))
+
+        self._progress_ch.close()
+
+    async def _run_supply_move(
+        self,
+        stop_ch: Chan,
+        node: str,
+        next_moves: list[NextMoves],
+        broadcast_stop_ch: Chan,
+        broadcast_done_ch: Chan,
+    ) -> None:
+        """Feed one node one batch, or wait on an in-flight move
+        (orchestrate.go:622-696)."""
+        next_done_ch = None
+        for nm in next_moves:
+            if nm.next_done_ch is not None:
+                next_done_ch = nm.next_done_ch
+                break
+
+        if next_done_ch is None:
+            next_done_ch = Chan()
+            req = _PartitionMoveReq(
+                partition_moves=[
+                    PartitionMove(
+                        partition=nm.partition,
+                        node=nm.moves[nm.next].node,
+                        state=nm.moves[nm.next].state,
+                        op=nm.moves[nm.next].op,
+                    )
+                    for nm in next_moves
+                ],
+                done_ch=next_done_ch,
+            )
+
+            # A move can target a node with no mover (not in nodes_all).  The
+            # reference sends on a nil channel there, which blocks until the
+            # stop/broadcast branch fires (orchestrate.go:667 with a missing
+            # map key) — the move simply stalls, it does not error.  A fresh
+            # never-received Chan reproduces that.
+            req_ch = self._map_node_to_req_ch.get(node)
+            if req_ch is None:
+                req_ch = Chan()
+            which, _ = await select(
+                (GET, stop_ch),
+                (GET, broadcast_stop_ch),
+                (PUT, req_ch, req),
+            )
+            if which == 0:
+                await broadcast_done_ch.put(ErrorStopped)
+                return
+            if which == 1:
+                await broadcast_done_ch.put(ErrorInterrupt)
+                return
+            for nm in next_moves:
+                nm.next_done_ch = next_done_ch
+
+        which, value = await select(
+            (GET, stop_ch),
+            (GET, broadcast_stop_ch),
+            (GET, next_done_ch),
+        )
+        if which == 0:
+            await broadcast_done_ch.put(ErrorStopped)
+        elif which == 1:
+            await broadcast_done_ch.put(ErrorInterrupt)
+        else:
+            err_val, ok = value
+            err = err_val if ok else None
+            for nm in next_moves:
+                if nm.next_done_ch is next_done_ch:
+                    nm.next_done_ch = None
+                    nm.next += 1
+            await broadcast_done_ch.put(err)
+
+    async def _wait_for_all_movers_done(self, run_mover_done_ch: Chan) -> None:
+        """Collect every mover's exit, folding errors into progress
+        (orchestrate.go:718-731)."""
+        for _ in range(len(self.nodes_all)):
+            err, _ok = await run_mover_done_ch.get()
+
+            def count():
+                self._progress.tot_run_mover_done += 1
+                if err is not None:
+                    self._progress.errors.append(err)
+                    self._progress.tot_run_mover_done_err += 1
+            await self._update_progress(count)
+
+
+def orchestrate_moves(
+    model: PartitionModel,
+    options: OrchestratorOptions,
+    nodes_all: Optional[list[str]],
+    beg_map: PartitionMap,
+    end_map: PartitionMap,
+    assign_partitions: AssignPartitionsFunc,
+    find_move: Optional[FindMoveFunc] = None,
+) -> Orchestrator:
+    """Asynchronously begin reassigning partitions from beg_map to end_map
+    (orchestrate.go:240-338).  Must be called with a running asyncio loop;
+    the caller must drain ``progress_ch()`` until it closes.
+
+    assign_partitions(stop_ch, node, partitions, states, ops) performs the
+    actual data movement for a batch, blocking until done; it may be sync or
+    async, and signals failure by raising or returning an Exception.
+
+    find_move(node, moves) -> index picks each node's next move; defaults to
+    lowest_weight_partition_move_for_node.
+    """
+    if len(beg_map) != len(end_map):
+        raise ValueError("mismatched begMap and endMap")
+    if assign_partitions is None:
+        raise ValueError(
+            "callback implementation for AssignPartitionsFunc is expected")
+
+    nodes_all = list(nodes_all or [])
+    states = sort_state_names(model)
+
+    # Per-partition flight plans, computed up front without regard to other
+    # partitions (orchestrate.go:264-287).
+    map_partition_to_next_moves: dict[str, NextMoves] = {}
+    for partition_name, beg_partition in beg_map.items():
+        end_partition = end_map[partition_name]
+        moves = calc_partition_moves(
+            states,
+            beg_partition.nodes_by_state,
+            end_partition.nodes_by_state,
+            options.favor_min_nodes,
+        )
+        map_partition_to_next_moves[partition_name] = NextMoves(
+            partition_name, moves)
+
+    o = Orchestrator(
+        model, options, nodes_all, beg_map, end_map,
+        assign_partitions, find_move, map_partition_to_next_moves,
+    )
+    o._start(o._stop_ch)
+    return o

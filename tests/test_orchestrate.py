@@ -1,0 +1,510 @@
+"""Ports of the reference's orchestrator tests (orchestrate_test.go:41-1811):
+validation, error propagation, pause/resume, early stop, concurrent batch
+sizes, and the 13 end-to-end exact-op-sequence scenarios."""
+
+import asyncio
+
+import pytest
+
+from blance_tpu import Partition, PartitionModelState
+from blance_tpu.orchestrate import (
+    Chan,
+    Orchestrator,
+    OrchestratorOptions,
+    lowest_weight_partition_move_for_node,
+    orchestrate_moves,
+)
+
+MR_MODEL = {
+    "primary": PartitionModelState(priority=0, constraints=0),
+    "replica": PartitionModelState(priority=0, constraints=1),
+}
+
+OPTIONS1 = OrchestratorOptions(max_concurrent_partition_moves_per_node=1)
+
+
+def pm(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+def mk_funcs():
+    """In-memory fake backend (orchestrate_test.go:130-164): records
+    (partition, node, state, op) per partition and tracks current states."""
+    curr_states = {}
+    recs = {}
+
+    def assign(stop_ch, node, partitions, states, ops):
+        recs.setdefault(partitions[0], []).append(
+            (partitions[0], node, states[0], ops[0]))
+        curr_states.setdefault(partitions[0], {})[node] = states[0]
+        return None
+
+    return curr_states, recs, assign
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_orchestrate_bad_moves():
+    async def go():
+        with pytest.raises(ValueError):
+            orchestrate_moves(
+                MR_MODEL, OPTIONS1, None,
+                pm({"00": {}, "01": {}}),
+                pm({"01": {}}),
+                lambda *a: None,
+            )
+        with pytest.raises(ValueError):
+            orchestrate_moves(MR_MODEL, OPTIONS1, None, pm({}), pm({}), None)
+    run(go())
+
+
+def test_orchestrate_err_assign_partition_func():
+    the_err = RuntimeError("theErr")
+
+    async def go():
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), ["a", "b"],
+            pm({"00": {"primary": ["a"]}}),
+            pm({"00": {"primary": ["b"]}}),
+            lambda *a: the_err,
+        )
+        got_progress = 0
+        last = None
+        async for progress in o.progress_ch():
+            got_progress += 1
+            last = progress
+        o.stop()
+        assert got_progress > 0
+        assert len(last.errors) > 0
+        seen = {}
+        o.visit_next_moves(lambda x: seen.update(x))
+        assert seen
+    run(go())
+
+
+@pytest.mark.parametrize("num_progress", [1, 2])
+def test_orchestrate_pause_resume(num_progress):
+    """orchestrate_test.go:166-280."""
+    _, _, assign = mk_funcs()
+
+    async def go():
+        pause_gate = Chan()
+
+        async def slow_assign(stop_ch, node, partitions, states, ops):
+            await pause_gate.get()
+            return assign(stop_ch, node, partitions, states, ops)
+
+        three = {
+            name: {"primary": ["a"], "replica": ["b"]}
+            for name in ("00", "01", "02")
+        }
+        three_flipped = {
+            name: {"primary": ["b"], "replica": ["a"]}
+            for name in ("00", "01", "02")
+        }
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), ["a", "b"],
+            pm(three), pm(three_flipped),
+            slow_assign,
+        )
+        for _ in range(num_progress):
+            await o.progress_ch().get()
+
+        o.pause_new_assignments()
+        o.pause_new_assignments()
+        o.pause_new_assignments()
+
+        o.resume_new_assignments()
+        o.resume_new_assignments()
+
+        pause_gate.close()
+
+        got_progress = 0
+        last = None
+        async for progress in o.progress_ch():
+            got_progress += 1
+            last = progress
+            o.resume_new_assignments()
+        o.stop()
+
+        assert got_progress > 0
+        assert not last.errors
+        assert last.tot_pause_new_assignments == 1
+        assert last.tot_resume_new_assignments == 1
+    run(go())
+
+
+def test_orchestrate_pause_resume_into_moves_supplier():
+    """orchestrate_test.go:284-393."""
+    _, _, assign = mk_funcs()
+
+    async def go():
+        slow_gate = Chan()
+        n_calls = 0
+
+        async def slow_assign(stop_ch, node, partitions, states, ops):
+            nonlocal n_calls
+            n_calls += 1
+            if n_calls > 1:
+                await slow_gate.get()
+            return assign(stop_ch, node, partitions, states, ops)
+
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), ["a", "b", "c"],
+            pm({"00": {"primary": ["a"], "replica": ["b"]},
+                "01": {"primary": ["b"], "replica": ["c"]}}),
+            pm({"00": {"primary": ["b"], "replica": ["c"]},
+                "01": {"primary": ["c"], "replica": ["a"]}}),
+            slow_assign,
+        )
+        for _ in range(2):
+            await o.progress_ch().get()
+
+        o.pause_new_assignments()
+        o.pause_new_assignments()
+        o.pause_new_assignments()
+        o.resume_new_assignments()
+        o.resume_new_assignments()
+
+        slow_gate.close()
+
+        got_progress = 0
+        last = None
+        async for progress in o.progress_ch():
+            got_progress += 1
+            last = progress
+            o.resume_new_assignments()
+        o.stop()
+
+        assert got_progress > 0
+        assert not last.errors
+        assert last.tot_pause_new_assignments == 1
+        assert last.tot_resume_new_assignments == 1
+    run(go())
+
+
+def test_orchestrate_early_stop():
+    _, _, assign = mk_funcs()
+
+    async def go():
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), ["a", "b"],
+            pm({"00": {"primary": ["a"]}}),
+            pm({"00": {"primary": ["b"]}}),
+            assign,
+        )
+        await o.progress_ch().get()
+
+        o.stop()
+        o.stop()
+        o.stop()
+
+        got_progress = 0
+        last = None
+        async for progress in o.progress_ch():
+            got_progress += 1
+            last = progress
+
+        assert got_progress > 0
+        assert not last.errors
+        assert last.tot_stop == 1
+    run(go())
+
+
+# --- TestOrchestrateConcurrentMoves (orchestrate_test.go:452-1047) ----------
+
+CONCURRENT_CASES = [
+    dict(
+        label="2 node, 2 partition movement",
+        max_concurrent=2, num_progress=1,
+        nodes=["a", "b"],
+        beg={"00": {"primary": ["a"], "replica": []},
+             "01": {"primary": ["a"], "replica": []},
+             "02": {"primary": ["a"], "replica": []},
+             "03": {"primary": ["a"], "replica": []}},
+        end={"00": {"primary": ["a"], "replica": []},
+             "01": {"primary": ["a"], "replica": []},
+             "02": {"primary": ["b"], "replica": []},
+             "03": {"primary": ["b"], "replica": []}},
+        exp_node="b", exp_count=2,
+        exp_partitions=["02", "03"],
+        exp_states=["primary", "primary"],
+        exp_ops=["add", "add"],
+    ),
+    dict(
+        label="1 node, 4 partition movement",
+        max_concurrent=4, num_progress=1,
+        nodes=["a"],
+        beg={"00": {}, "01": {}, "02": {}, "03": {}},
+        end={name: {"primary": ["a"], "replica": []}
+             for name in ("00", "01", "02", "03")},
+        exp_node="a", exp_count=4,
+        exp_partitions=["00", "01", "02", "03"],
+        exp_states=["primary"] * 4,
+        exp_ops=["add"] * 4,
+    ),
+    dict(
+        label="1 node delete, 2 partition promote",
+        max_concurrent=4, num_progress=1,
+        nodes=["a"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["a"], "replica": ["b"]},
+             "02": {"primary": ["b"], "replica": ["a"]},
+             "03": {"primary": ["b"], "replica": ["a"]}},
+        end={name: {"primary": ["a"], "replica": []}
+             for name in ("00", "01", "02", "03")},
+        exp_node="a", exp_count=2,
+        exp_partitions=["02", "03"],
+        exp_states=["primary", "primary"],
+        exp_ops=["promote", "promote"],
+    ),
+    dict(
+        label="1 node delete, 2 partition del",
+        max_concurrent=2, num_progress=2,
+        nodes=["a", "b"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["a"], "replica": ["b"]},
+             "02": {"primary": ["b"], "replica": ["a"]},
+             "03": {"primary": ["b"], "replica": ["a"]}},
+        end={name: {"primary": ["a"], "replica": []}
+             for name in ("00", "01", "02", "03")},
+        exp_node="b", exp_count=2,
+        exp_partitions=["00", "01"],
+        exp_states=["", ""],
+        exp_ops=["del", "del"],
+    ),
+    dict(
+        label="2 node deletions out of 3 node cluster (concurrency 2)",
+        max_concurrent=2, num_progress=6,
+        nodes=["a", "b", "c"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["a"], "replica": ["c"]},
+             "02": {"primary": ["b"], "replica": ["a"]},
+             "03": {"primary": ["b"], "replica": ["c"]},
+             "04": {"primary": ["c"], "replica": ["a"]},
+             "05": {"primary": ["c"], "replica": ["b"]}},
+        end={name: {"primary": ["a"], "replica": []}
+             for name in ("00", "01", "02", "03", "04", "05")},
+        exp_node="a", exp_count=2, skip_callbacks=1,
+        exp_partitions=["03", "05"],
+        exp_states=["primary", "primary"],
+        exp_ops=["add", "add"],
+    ),
+    dict(
+        label="2 node deletions out of 3 node cluster (concurrency 4)",
+        max_concurrent=4, num_progress=6,
+        nodes=["a", "b", "c"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["a"], "replica": ["c"]},
+             "02": {"primary": ["b"], "replica": ["a"]},
+             "03": {"primary": ["b"], "replica": ["c"]},
+             "04": {"primary": ["c"], "replica": ["a"]},
+             "05": {"primary": ["c"], "replica": ["b"]}},
+        end={name: {"primary": ["a"], "replica": []}
+             for name in ("00", "01", "02", "03", "04", "05")},
+        exp_node="a", exp_count=4,
+        exp_partitions=["02", "03", "04", "05"],
+        exp_states=["primary"] * 4,
+        exp_ops=["promote", "promote", "add", "add"],
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CONCURRENT_CASES,
+                         ids=[c["label"] for c in CONCURRENT_CASES])
+def test_orchestrate_concurrent_moves(case):
+    _, _, record_assign = mk_funcs()
+    failures = []
+
+    async def go():
+        skip_callbacks = case.get("skip_callbacks", 0)
+
+        def assign(stop_ch, node, partitions, states, ops):
+            nonlocal skip_callbacks
+            if case["exp_node"] != node:
+                return None
+            if skip_callbacks > 0:
+                skip_callbacks -= 1
+                return None
+            if len(partitions) != case["exp_count"]:
+                failures.append(
+                    f"batch size {len(partitions)} != {case['exp_count']}")
+            if sorted(partitions) != case["exp_partitions"]:
+                failures.append(f"partitions {sorted(partitions)}")
+            if sorted(states) != case["exp_states"]:
+                failures.append(f"states {sorted(states)}")
+            if ops != case["exp_ops"]:
+                failures.append(f"ops {ops}")
+            record_assign(stop_ch, node, partitions, states, ops)
+            return None
+
+        o = orchestrate_moves(
+            MR_MODEL,
+            OrchestratorOptions(
+                max_concurrent_partition_moves_per_node=case["max_concurrent"]),
+            case["nodes"], pm(case["beg"]), pm(case["end"]),
+            assign,
+        )
+        while True:
+            prog, ok = await o.progress_ch().get()
+            if not ok:
+                break
+            if prog.tot_mover_assign_partition_ok >= case["num_progress"]:
+                break
+        o.stop()
+        # Drain to completion so all tasks wind down.
+        async for _ in o.progress_ch():
+            pass
+
+    run(go())
+    assert not failures, failures
+
+
+# --- TestOrchestrateMoves: 13 end-to-end scenarios (orchestrate_test.go:1049) --
+
+MOVES_CASES = [
+    dict(label="do nothing", nodes=None, beg={}, end={}, expect={}),
+    dict(label="1 node, no assignments or changes", nodes=["a"],
+         beg={}, end={}, expect={}),
+    dict(label="no nodes, but some partitions", nodes=None,
+         beg={"00": {}, "01": {}}, end={"00": {}, "01": {}}, expect={}),
+    dict(
+        label="add node a, 1 partition",
+        nodes=["a"], beg={"00": {}}, end={"00": {"primary": ["a"]}},
+        expect={"00": [("00", "a", "primary")]},
+    ),
+    dict(
+        label="add node a & b, 1 partition",
+        nodes=["a", "b"], beg={"00": {}},
+        end={"00": {"primary": ["a"], "replica": ["b"]}},
+        expect={"00": [("00", "a", "primary"), ("00", "b", "replica")]},
+    ),
+    dict(
+        label="add node a & b & c, 1 partition",
+        nodes=["a", "b", "c"], beg={"00": {}},
+        end={"00": {"primary": ["a"], "replica": ["b"]}},
+        expect={"00": [("00", "a", "primary"), ("00", "b", "replica")]},
+    ),
+    dict(
+        label="del node a, 1 partition",
+        nodes=["a"], beg={"00": {"primary": ["a"]}}, end={"00": {}},
+        expect={"00": [("00", "a", "")]},
+    ),
+    dict(
+        label="swap a to b, 1 partition",
+        nodes=["a", "b"],
+        beg={"00": {"primary": ["a"]}}, end={"00": {"primary": ["b"]}},
+        expect={"00": [("00", "b", "primary"), ("00", "a", "")]},
+    ),
+    dict(
+        label="swap a to b, 1 partition, c unchanged",
+        nodes=["a", "b", "c"],
+        beg={"00": {"primary": ["a"], "replica": ["c"]}},
+        end={"00": {"primary": ["b"], "replica": ["c"]}},
+        expect={"00": [("00", "b", "primary"), ("00", "a", "")]},
+    ),
+    dict(
+        label="1 partition from a|b to c|a",
+        nodes=["a", "b", "c"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]}},
+        end={"00": {"primary": ["c"], "replica": ["a"]}},
+        expect={"00": [("00", "c", "primary"), ("00", "a", "replica"),
+                       ("00", "b", "")]},
+    ),
+    dict(
+        label="add node a & b, 2 partitions",
+        nodes=["a", "b"],
+        beg={"00": {}, "01": {}},
+        end={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["b"], "replica": ["a"]}},
+        expect={"00": [("00", "a", "primary"), ("00", "b", "replica")],
+                "01": [("01", "b", "primary"), ("01", "a", "replica")]},
+    ),
+    dict(
+        label="swap ab to cd, 2 partitions",
+        nodes=["a", "b", "c", "d"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["b"], "replica": ["a"]}},
+        end={"00": {"primary": ["c"], "replica": ["d"]},
+             "01": {"primary": ["d"], "replica": ["c"]}},
+        expect={"00": [("00", "c", "primary"), ("00", "a", ""),
+                       ("00", "d", "replica"), ("00", "b", "")],
+                "01": [("01", "d", "primary"), ("01", "b", ""),
+                       ("01", "c", "replica"), ("01", "a", "")]},
+    ),
+    dict(
+        label="concurrent moves on b, 2 partitions",
+        nodes=["a", "b", "c"],
+        beg={"00": {"primary": ["b"], "replica": ["a"]},
+             "01": {"primary": ["b"], "replica": ["a"]}},
+        end={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["c"], "replica": ["a"]}},
+        expect={"00": [("00", "a", "primary"), ("00", "b", "replica")],
+                "01": [("01", "c", "primary"), ("01", "b", "")]},
+    ),
+    dict(
+        label="nodes with not much work",
+        nodes=["a", "b", "c", "d", "e"],
+        beg={"00": {"primary": ["b"], "replica": ["a", "d", "e"]},
+             "01": {"primary": ["b"], "replica": ["a", "d", "e"]}},
+        end={"00": {"primary": ["a"], "replica": ["b", "d", "e"]},
+             "01": {"primary": ["c"], "replica": ["a", "d", "e"]}},
+        expect={"00": [("00", "a", "primary"), ("00", "b", "replica")],
+                "01": [("01", "c", "primary"), ("01", "b", "")]},
+    ),
+    dict(
+        label="more concurrent moves",
+        nodes=["a", "b", "c", "d", "e", "f", "g"],
+        beg={"00": {"primary": ["a"], "replica": ["b"]},
+             "01": {"primary": ["b"], "replica": ["c"]},
+             "02": {"primary": ["c"], "replica": ["d"]},
+             "03": {"primary": ["d"], "replica": ["e"]},
+             "04": {"primary": ["e"], "replica": ["f"]},
+             "05": {"primary": ["f"], "replica": ["g"]}},
+        end={"00": {"primary": ["b"], "replica": ["c"]},
+             "01": {"primary": ["c"], "replica": ["d"]},
+             "02": {"primary": ["d"], "replica": ["e"]},
+             "03": {"primary": ["e"], "replica": ["f"]},
+             "04": {"primary": ["f"], "replica": ["g"]},
+             "05": {"primary": ["g"], "replica": ["a"]}},
+        expect={"00": [("00", "b", "primary"), ("00", "a", ""),
+                       ("00", "c", "replica")],
+                "01": [("01", "c", "primary"), ("01", "b", ""),
+                       ("01", "d", "replica")],
+                "02": [("02", "d", "primary"), ("02", "c", ""),
+                       ("02", "e", "replica")],
+                "03": [("03", "e", "primary"), ("03", "d", ""),
+                       ("03", "f", "replica")],
+                "04": [("04", "f", "primary"), ("04", "e", ""),
+                       ("04", "g", "replica")],
+                "05": [("05", "g", "primary"), ("05", "f", ""),
+                       ("05", "a", "replica")]},
+    ),
+]
+
+
+@pytest.mark.parametrize("case", MOVES_CASES,
+                         ids=[c["label"] for c in MOVES_CASES])
+def test_orchestrate_moves(case):
+    _, recs, assign = mk_funcs()
+
+    async def go():
+        o = orchestrate_moves(
+            MR_MODEL, OPTIONS1, case["nodes"],
+            pm(case["beg"]), pm(case["end"]),
+            assign,
+            lowest_weight_partition_move_for_node,
+        )
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+
+    run(go())
+
+    assert len(recs) == len(case["expect"]), (recs, case["expect"])
+    for partition, exp_seq in case["expect"].items():
+        got = [(p, n, s) for (p, n, s, _op) in recs[partition]]
+        assert got == exp_seq, f"{case['label']}: {partition}: {got} != {exp_seq}"
