@@ -1,0 +1,132 @@
+"""Benchmark: batched TPU planner vs the sequential CPU greedy planner.
+
+Headline config (BASELINE.json north star direction): plan 100k partitions
+x 1k nodes, primary + 1 replica, from a warm previous map with 5% of nodes
+removed — the realistic delta-rebalance shape.  The TPU number is the
+on-device solve (jit-compiled, post-warmup, synchronized); the CPU baseline
+is this repo's exact greedy planner (the reference publishes no benchmark
+numbers — BASELINE.md), measured on a P-subsampled problem and scaled
+linearly in P (the greedy loop is linear in P for fixed N and S;
+SURVEY.md §3.1).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+plus human-readable detail on stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+P_FULL = 100_000
+N_NODES = 1_000
+CPU_P = 4_000  # greedy measured here, scaled to P_FULL linearly
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def build_dense(P, N, seed=0):
+    rng = np.random.default_rng(seed)
+    S, R = 2, 1
+    prev = np.full((P, S, R), -1, np.int32)
+    prev[:, 0, 0] = rng.integers(0, N, P)
+    prev[:, 1, 0] = (prev[:, 0, 0] + 1 + rng.integers(0, N - 1, P)) % N
+    pweights = np.ones(P, np.float32)
+    nweights = np.ones(N, np.float32)
+    valid = np.ones(N, bool)
+    valid[rng.choice(N, N // 20, replace=False)] = False  # 5% nodes leave
+    stickiness = np.full((P, S), 1.5, np.float32)
+    gids = np.stack([np.arange(N, dtype=np.int32),
+                     np.arange(N, dtype=np.int32) // 25,
+                     np.zeros(N, np.int32)])
+    gid_valid = np.ones((3, N), bool)
+    constraints = (1, 1)
+    rules = ((), ((2, 1),))  # replica on another rack
+    return (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+            constraints, rules)
+
+
+def bench_tpu():
+    import jax
+    import jax.numpy as jnp
+    from blance_tpu.plan.tensor import solve_dense
+
+    args = build_dense(P_FULL, N_NODES)
+    (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+     constraints, rules) = args
+    dev_args = [jnp.asarray(a) for a in
+                (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+
+    log(f"devices: {jax.devices()}")
+
+    # block_until_ready is unreliable on the experimental axon platform, so
+    # force completion with a small host copy ([P] primaries, ~400KB).
+    def run():
+        out = solve_dense(*dev_args, constraints, rules)
+        np.asarray(out[:, 0, 0])
+        return out
+
+    t0 = time.perf_counter()
+    out = run()
+    compile_s = time.perf_counter() - t0
+    log(f"tpu compile+first-run: {compile_s:.2f}s")
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run()
+        times.append(time.perf_counter() - t0)
+    tpu_s = min(times)
+    log(f"tpu solve {P_FULL}x{N_NODES}: {tpu_s*1000:.1f}ms (runs: "
+        f"{[f'{t*1000:.1f}' for t in times]})")
+
+    # Sanity: all primaries assigned, none on removed nodes.
+    a = np.asarray(out)
+    assert (a[:, 0, 0] >= 0).all()
+    assert valid[a[a >= 0]].all(), "assignment used a removed node"
+    return tpu_s
+
+
+def bench_cpu_greedy():
+    from blance_tpu import Partition, PlanOptions, model, plan_next_map
+
+    rng = np.random.default_rng(0)
+    nodes = [f"n{i:04d}" for i in range(N_NODES)]
+    removed = [nodes[i] for i in
+               rng.choice(N_NODES, N_NODES // 20, replace=False)]
+    m = model(primary=(0, 1), replica=(1, 1))
+    prev = {}
+    for i in range(CPU_P):
+        p = rng.integers(0, N_NODES)
+        r = (p + 1 + rng.integers(0, N_NODES - 1)) % N_NODES
+        prev[str(i)] = Partition(str(i), {"primary": [nodes[p]],
+                                          "replica": [nodes[r]]})
+    opts = PlanOptions(max_iterations=1)  # single pass, same work as solve
+    t0 = time.perf_counter()
+    plan_next_map(prev, prev, nodes, removed, [], m, opts, backend="greedy")
+    cpu_s = time.perf_counter() - t0
+    scaled = cpu_s * (P_FULL / CPU_P)
+    log(f"cpu greedy {CPU_P}x{N_NODES}: {cpu_s:.2f}s "
+        f"-> scaled to {P_FULL}: {scaled:.1f}s")
+    return scaled
+
+
+def main():
+    tpu_s = bench_tpu()
+    cpu_s = bench_cpu_greedy()
+    print(json.dumps({
+        "metric": f"plan_next_map wall-clock @ {P_FULL//1000}k partitions x "
+                  f"{N_NODES//1000}k nodes (primary+replica, rack rules, "
+                  f"5% node removal)",
+        "value": round(tpu_s * 1000, 2),
+        "unit": "ms",
+        "vs_baseline": round(cpu_s / tpu_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,242 @@
+"""Dense encoding: PartitionMap <-> int32/float32 arrays.
+
+The reference's data model is maps of strings (reference api.go:24-36); the
+TPU planner needs dense tensors.  This module interns node/partition/state
+names to ids and packs the planning problem into arrays:
+
+- assign[P, S, R] : int32 node ids, -1 = empty slot (R = max slots seen).
+- constraints[S]  : per-state target copy counts, priority-ordered.
+- weights         : float32 partition/node weights.
+- hierarchy       : per-level group ids per node (see
+  core.hierarchy.level_group_ids) so include/exclude rules are integer
+  compares, never N x N masks (SURVEY.md §7 hard part 2).
+
+Partitions are ordered by the same zero-padded-numeric-else-raw name key the
+planner sorts by, so dense ids match the greedy planner's deterministic
+iteration order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .hierarchy import find_ancestor, level_group_ids
+from .setops import strings_remove
+from .types import (
+    HierarchyRules,
+    Partition,
+    PartitionMap,
+    PartitionModel,
+    PlanOptions,
+)
+
+__all__ = ["DenseProblem", "encode_problem", "decode_assignment"]
+
+
+@dataclass
+class DenseProblem:
+    """A fully interned planning problem, ready for the tensor planner."""
+
+    nodes: list[str]  # id -> name, in nodes_all order (ties break by this)
+    partitions: list[str]  # id -> name, in planner sort order
+    states: list[str]  # priority-ordered (sort_state_names)
+
+    constraints: np.ndarray  # [S] int32
+    prev: np.ndarray  # [P, S, R] int32 node ids, -1 empty
+    partition_weights: np.ndarray  # [P] float32
+    node_weights: np.ndarray  # [N] float32 (raw; may be negative)
+    valid_node: np.ndarray  # [N] bool — False for nodes_to_remove
+    stickiness: np.ndarray  # [P, S] float32
+
+    # Hierarchy: group ids per level per node; level 0 = the node itself.
+    # gids[l, n] == gids[l, m] iff nodes n, m share their level-l ancestor.
+    gids: np.ndarray  # [L, N] int32
+    gid_valid: np.ndarray  # [L, N] bool — ancestor exists at that level
+    # Per state, list of (include_level, exclude_level) rules.
+    rules: dict[int, list[tuple[int, int]]]
+
+    @property
+    def P(self) -> int:
+        return len(self.partitions)
+
+    @property
+    def N(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def S(self) -> int:
+        return len(self.states)
+
+    @property
+    def R(self) -> int:
+        return self.prev.shape[2] if self.prev.size else 0
+
+
+def encode_problem(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    model: PartitionModel,
+    opts: PlanOptions,
+) -> DenseProblem:
+    """Intern and pack a planning problem into dense arrays."""
+    # Deferred to avoid a core <-> plan import cycle at package init; the
+    # greedy key function is the single source of truth so dense ids match
+    # the greedy planner's deterministic iteration order exactly.
+    from ..plan.greedy import _partition_name_key, sort_state_names
+
+    nodes = list(nodes_all)
+    node_index = {n: i for i, n in enumerate(nodes)}
+
+    partitions = sorted(
+        partitions_to_assign.keys(), key=lambda n: (_partition_name_key(n), n)
+    )
+    states = sort_state_names(model)
+    state_index = {s: i for i, s in enumerate(states)}
+
+    constraints = np.zeros(len(states), dtype=np.int32)
+    for s, st in model.items():
+        c = st.constraints
+        if opts.model_state_constraints is not None:
+            c = opts.model_state_constraints.get(s, c)
+        constraints[state_index[s]] = c
+
+    # Slot depth: enough for the widest constraint and the widest prev row.
+    r_max = int(constraints.max()) if len(constraints) else 0
+    for pname in partitions:
+        src = prev_map.get(pname) or partitions_to_assign[pname]
+        for s, ns in src.nodes_by_state.items():
+            if s in state_index:
+                r_max = max(r_max, len(ns))
+    r_max = max(r_max, 1)
+
+    P, S, N = len(partitions), len(states), len(nodes)
+    prev = np.full((P, S, r_max), -1, dtype=np.int32)
+    for pi, pname in enumerate(partitions):
+        src = prev_map.get(pname) or partitions_to_assign.get(pname)
+        if src is None:
+            continue
+        for s, ns in src.nodes_by_state.items():
+            si = state_index.get(s)
+            if si is None:
+                continue
+            for ri, node in enumerate(ns[:r_max]):
+                prev[pi, si, ri] = node_index.get(node, -1)
+
+    pweights = np.ones(P, dtype=np.float32)
+    if opts.partition_weights:
+        for pi, pname in enumerate(partitions):
+            pweights[pi] = opts.partition_weights.get(pname, 1)
+
+    nweights = np.ones(N, dtype=np.float32)
+    if opts.node_weights:
+        for ni, n in enumerate(nodes):
+            nweights[ni] = opts.node_weights.get(n, 1)
+
+    valid = np.ones(N, dtype=bool)
+    if nodes_to_remove:
+        removed = set(nodes_to_remove)
+        for ni, n in enumerate(nodes):
+            if n in removed:
+                valid[ni] = False
+
+    # Stickiness per (partition, state), with the reference's resolution
+    # order (plan.go:104-115): partition weight if present, else state
+    # stickiness (gated on partition_weights presence unless the standalone
+    # compat switch), else 1.5.
+    stickiness = np.full((P, S), 1.5, dtype=np.float32)
+    pw = opts.partition_weights
+    ss = opts.state_stickiness
+    ss_active = ss is not None and (pw is not None or opts.state_stickiness_standalone)
+    for pi, pname in enumerate(partitions):
+        if pw is not None and pname in pw:
+            stickiness[pi, :] = pw[pname]
+        elif ss_active:
+            for si, s in enumerate(states):
+                if s in ss:
+                    stickiness[pi, si] = ss[s]
+
+    # Hierarchy group ids.  Levels needed = max level referenced by any rule.
+    rules_by_state: dict[int, list[tuple[int, int]]] = {}
+    max_level = 0
+    if opts.hierarchy_rules:
+        for s, rl in opts.hierarchy_rules.items():
+            si = state_index.get(s)
+            if si is None:
+                continue
+            rules_by_state[si] = [
+                (r.include_level, r.exclude_level) for r in rl
+            ]
+            for r in rl:
+                max_level = max(max_level, r.include_level, r.exclude_level)
+
+    gid_rows = level_group_ids(nodes, opts.node_hierarchy, max_level)
+    gids = np.asarray(gid_rows, dtype=np.int32).reshape(max_level + 1, N) \
+        if N else np.zeros((max_level + 1, 0), np.int32)
+    gid_valid = np.ones((max_level + 1, N), dtype=bool)
+    for level in range(max_level + 1):
+        for ni, n in enumerate(nodes):
+            gid_valid[level, ni] = find_ancestor(n, opts.node_hierarchy, level) != ""
+
+    return DenseProblem(
+        nodes=nodes,
+        partitions=partitions,
+        states=states,
+        constraints=constraints,
+        prev=prev,
+        partition_weights=pweights,
+        node_weights=nweights,
+        valid_node=valid,
+        stickiness=stickiness,
+        gids=gids,
+        gid_valid=gid_valid,
+        rules=rules_by_state,
+    )
+
+
+def decode_assignment(
+    problem: DenseProblem,
+    assign: np.ndarray,  # [P, S, R] int32 node ids, -1 empty
+    partitions_to_assign: PartitionMap,
+    nodes_to_remove: Optional[list[str]] = None,
+) -> tuple[PartitionMap, dict[str, list[str]]]:
+    """Dense assignment -> PartitionMap + constraint-shortfall warnings.
+
+    States absent from the model keep their (removed-node-stripped) previous
+    assignment, matching the greedy planner's pass-through of unmodeled
+    states.
+    """
+    assign = np.asarray(assign)
+    next_map: PartitionMap = {}
+    warnings: dict[str, list[str]] = {}
+    state_set = set(problem.states)
+
+    for pi, pname in enumerate(problem.partitions):
+        nbs: dict[str, list[str]] = {}
+        # Pass through unmodeled states from the source partition.
+        src = partitions_to_assign.get(pname)
+        if src is not None:
+            for s, ns in src.nodes_by_state.items():
+                if s not in state_set:
+                    nbs[s] = strings_remove(ns, nodes_to_remove or [])
+        for si, sname in enumerate(problem.states):
+            want = int(problem.constraints[si])
+            if want <= 0:
+                if src is not None and sname in src.nodes_by_state:
+                    nbs[sname] = strings_remove(
+                        src.nodes_by_state[sname], nodes_to_remove or [])
+                continue
+            ids = [int(x) for x in assign[pi, si] if x >= 0]
+            nbs[sname] = [problem.nodes[i] for i in ids]
+            if len(ids) < want:
+                warnings.setdefault(pname, []).append(
+                    "could not meet constraints: %d, stateName: %s,"
+                    " partitionName: %s" % (want, sname, pname)
+                )
+        next_map[pname] = Partition(pname, nbs)
+
+    return next_map, warnings

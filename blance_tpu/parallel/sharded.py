@@ -1,0 +1,129 @@
+"""Multi-chip sharded planning: shard_map over the partition axis.
+
+This is the framework's distributed backbone (the analog of the reference's
+"scale" story, which is single-threaded Go — SURVEY.md §2.6).  The planning
+problem shards cleanly over partitions: scores[P, N] are embarrassingly
+parallel in P, and the only cross-shard state is per-node aggregate weight
+(counts, capacity usage), which rides XLA collectives (psum) over ICI.
+
+Design (SURVEY.md §5 long-context analog): the (P x S x N) cost tensor is
+sharded over P with a jax.sharding.Mesh; each device runs the same auction
+rounds on its partition shard with 1/n of every node's capacity, and psums
+its per-node accepted weight so the price/counts every shard sees stay
+globally consistent.  No gather of [P, N] ever materializes on one chip.
+
+The node axis is kept replicated in round 1; for >> 10k-node problems a
+second mesh axis with cross-shard argmin (pmin + index arithmetic) is the
+planned extension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.encode import DenseProblem
+from ..plan.tensor import solve_dense
+
+__all__ = ["make_mesh", "solve_dense_sharded", "pad_partitions"]
+
+PARTITION_AXIS = "parts"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D device mesh over the partition axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+
+
+def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+    """Pad axis 0 to a multiple of the mesh size.
+
+    Padding rows use weight 0 so they bid without consuming capacity or
+    affecting counts; their assignments are discarded at decode.
+    """
+    p = arr.shape[0]
+    rem = (-p) % multiple
+    if rem == 0:
+        return arr
+    pad_shape = (rem,) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+def solve_dense_sharded(
+    mesh: Mesh,
+    prev: np.ndarray,
+    pweights: np.ndarray,
+    nweights: np.ndarray,
+    valid: np.ndarray,
+    stickiness: np.ndarray,
+    gids: np.ndarray,
+    gid_valid: np.ndarray,
+    constraints: tuple,
+    rules: tuple,
+) -> np.ndarray:
+    """Run solve_dense under shard_map with the partition axis sharded.
+
+    Returns assign[P_original, S, R] (padding stripped).
+    """
+    n_shards = mesh.devices.size
+    p_orig = prev.shape[0]
+
+    prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
+    pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
+    st_p = pad_partitions(np.asarray(stickiness), n_shards, 0.0)
+
+    shard = P(PARTITION_AXIS)
+    rep = P()
+
+    fn = jax.shard_map(
+        partial(
+            solve_dense,
+            constraints=constraints,
+            rules=rules,
+            axis_name=PARTITION_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(shard, shard, rep, rep, shard, rep, rep),
+        out_specs=shard,
+    )
+
+    device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    assign = fn(
+        device_put(jnp.asarray(prev_p), shard),
+        device_put(jnp.asarray(pw_p), shard),
+        device_put(jnp.asarray(nweights), rep),
+        device_put(jnp.asarray(valid), rep),
+        device_put(jnp.asarray(st_p), shard),
+        device_put(jnp.asarray(gids), rep),
+        device_put(jnp.asarray(gid_valid), rep),
+    )
+    return np.asarray(assign)[:p_orig]
+
+
+def solve_problem_sharded(
+    mesh: Mesh, problem: DenseProblem
+) -> np.ndarray:
+    """Convenience: solve an encoded DenseProblem on a mesh."""
+    rules = tuple(tuple(problem.rules.get(si, ())) for si in range(problem.S))
+    constraints = tuple(int(c) for c in problem.constraints)
+    return solve_dense_sharded(
+        mesh,
+        problem.prev,
+        problem.partition_weights,
+        problem.node_weights,
+        problem.valid_node,
+        problem.stickiness,
+        problem.gids,
+        problem.gid_valid,
+        constraints,
+        rules,
+    )

@@ -1,0 +1,479 @@
+"""Batched cost-tensor planner — the TPU backend.
+
+Where the reference runs a sequential greedy loop over partitions
+(reference plan.go:253-303, O(S*P*(S*N + N log N)) on one core), this
+backend scores ALL partitions against ALL nodes at once and assigns each
+state/replica slot in fully-vectorized auction rounds:
+
+  score[P, N] = (holders_of_state[N] + 0.001 * fill[N] / P) / node_weight
+              + negative-weight boost       (plan.go:675-684 semantics)
+              - stickiness * held_previously (plan.go:654-662)
+              + tiered hierarchy-rule penalty (api.go:76-105 semantics)
+              + INF * forbidden              (same-partition exclusivity,
+                                              removed nodes)
+
+Assignment per slot runs capacity-constrained proposal rounds: every
+unassigned partition bids on its best open node; each node accepts bidders
+in most-urgent-first order (urgency = regret margin between best and
+second-best) up to its remaining weighted capacity; accepted bids update
+the counts that score the next round.  A deterministic per-(partition, node)
+tie-break jitter — far below any real score term — spreads equal-score bids
+across equally-good nodes, so a wave of identical partitions fills every
+node in one round instead of herding onto the argmin.  A final force step
+ignores capacity so constraint satisfaction never degrades below the greedy
+planner's (shortfalls become warnings, exactly like plan.go:231-235).
+
+Everything is jit-compiled with static (S, R, rules) structure: the slot
+loops unroll at trace time, the auction is a lax.while_loop, and the only
+cross-partition dependencies are per-node sums — which is what makes the
+partition axis shardable across a TPU mesh (see blance_tpu.parallel).
+
+Set axis_name to run under shard_map with the partition axis sharded:
+per-node bid totals are then psum'd over the mesh so capacity and counts
+stay globally consistent while scores stay local.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.encode import DenseProblem, decode_assignment, encode_problem
+from ..core.types import PartitionMap, PartitionModel, PlanOptions
+
+__all__ = ["plan_next_map_tpu", "solve_dense", "check_assignment"]
+
+_INF = 1.0e9  # hard-forbidden
+_RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
+_RULE_TIER = 1.0e4  # penalty step per rule index (earlier rules win)
+_MAX_AUCTION_ROUNDS = 16
+# Bid-spreading jitter: above the advisory fill factor (0.001/P) by design,
+# below every decision-bearing term (stickiness >= 1.5 typical, rule tiers
+# 1e4, price >= 1/node-weight per accepted unit).
+_JITTER = 1.0e-5
+
+
+def _drop_empty(ids: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Map empty (-1) ids to n so scatters with mode='drop' discard them.
+
+    NB: JAX .at[] wraps negative indices like NumPy — a raw -1 would
+    silently scatter onto the LAST node."""
+    return jnp.where(ids >= 0, ids, n)
+
+
+def _scatter_counts(ids: jnp.ndarray, weights: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Weighted histogram of node ids [P, R] -> [N]; -1 entries dropped."""
+    flat = _drop_empty(ids.reshape(-1), n)
+    w = jnp.broadcast_to(weights[:, None], ids.shape).reshape(-1)
+    return jnp.zeros(n, jnp.float32).at[flat].add(w, mode="drop")
+
+
+def _membership(ids: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[P, R] node ids -> [P, N] bool membership; -1 entries dropped."""
+    p = ids.shape[0]
+    out = jnp.zeros((p, n), jnp.bool_)
+    return out.at[jnp.arange(p)[:, None], _drop_empty(ids, n)].set(
+        True, mode="drop")
+
+
+def _hier_penalty(
+    anchor: jnp.ndarray,  # [P] node id or -1
+    gids: jnp.ndarray,  # [L, N]
+    gid_valid: jnp.ndarray,  # [L, N]
+    rules: tuple,  # ((include_level, exclude_level), ...)
+) -> jnp.ndarray:
+    """Tiered rule penalty [P, N]: first-satisfied rule index sets the tier;
+    satisfying none costs _RULE_MISS.  Unsatisfiable rules penalize every
+    node equally, which leaves the argmin order flat — the reference's
+    fall-back-to-flat-candidates behavior (plan.go:214-220)."""
+    p = anchor.shape[0]
+    n = gids.shape[1]
+    anchor_ok = anchor >= 0
+    a = jnp.maximum(anchor, 0)
+    pen = jnp.full((p, n), _RULE_MISS, jnp.float32)
+    for idx, (inc, exc) in enumerate(rules):
+        inc_same = (gids[inc][a][:, None] == gids[inc][None, :]) & \
+            gid_valid[inc][a][:, None]
+        exc_same = (gids[exc][a][:, None] == gids[exc][None, :]) & \
+            gid_valid[exc][a][:, None]
+        sat = inc_same & ~exc_same
+        pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
+    return jnp.where(anchor_ok[:, None], pen, 0.0)
+
+
+def _psum(x, axis_name):
+    return lax.psum(x, axis_name) if axis_name else x
+
+
+def _assign_slot(
+    score: jnp.ndarray,  # [P, N] (forbidden already folded in as +_INF)
+    pweights: jnp.ndarray,  # [P]
+    cap: jnp.ndarray,  # [N] weighted capacity for this slot (global)
+    price_scale: jnp.ndarray,  # [N] converts accepted weight into score units
+    jitter_scale: jnp.ndarray,  # scalar, <= half the smallest real delta
+    axis_name: Optional[str],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Auction: returns (slot_assign[P] int32 node id or -1, used[N] weight).
+
+    Each round: bid on the best open node, accept most-urgent bidders up to
+    remaining capacity (at least the first bidder per node, to guarantee
+    progress), repeat.  Ends when everyone is assigned or nothing moved.
+
+    Entirely shard-local: under shard_map the caller hands each shard its
+    slice of capacity and psums the returned per-node usage afterwards —
+    no collectives run inside the loop, so shards may take different round
+    counts.
+    """
+    p, n = score.shape
+
+    # Deterministic tie-break jitter (Weyl-style hash of (partition, node))
+    # so equal-score bids spread over equal nodes instead of herding.  The
+    # hash uses the GLOBAL partition index — with a shard-local index every
+    # shard would bid on the same jitter-preferred nodes in lockstep.
+    base = lax.axis_index(axis_name) * p if axis_name else 0
+    pi = (base + jnp.arange(p, dtype=jnp.uint32))[:, None].astype(jnp.uint32)
+    ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    jitter = ((pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503))
+              & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
+    score = score + jitter_scale * jitter
+
+    def round_body(carry):
+        slot_assign, unassigned, rem_cap, used, _progress, it = carry
+
+        open_pen = jnp.where(rem_cap > 0, 0.0, _INF)[None, :]
+        # Price: weight already accepted this slot raises a node's cost as
+        # if the counts term had updated, so bids keep spreading even
+        # within one slot wave.
+        eff = score + (used * price_scale)[None, :] + open_pen
+        best = jnp.min(eff, axis=1)
+        choice = jnp.argmin(eff, axis=1).astype(jnp.int32)
+        # Second-best for the urgency margin.
+        masked = eff.at[jnp.arange(p), choice].set(jnp.inf)
+        second = jnp.min(masked, axis=1)
+        margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
+
+        active = unassigned & (best < _INF / 2)
+
+        # Sort bidders by (node, urgency desc) via two stable argsorts —
+        # avoids packing into int64, which is x64-gated.  Inactive bidders
+        # sort to the end.
+        inv_margin = jnp.where(active, -margin, jnp.inf)
+        sort_choice = jnp.where(active, choice, n)
+        perm1 = jnp.argsort(inv_margin, stable=True)
+        perm2 = jnp.argsort(sort_choice[perm1], stable=True)
+        perm = perm1[perm2]
+
+        choice_s = choice[perm]
+        w_s = pweights[perm]
+        active_s = active[perm]
+
+        w_eff = jnp.where(active_s, w_s, 0.0)
+        csum = jnp.cumsum(w_eff)
+        ecs = csum - w_eff  # exclusive: weight of earlier bidders overall
+        seg_start = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), choice_s[1:] != choice_s[:-1]])
+        seg_base = lax.cummax(jnp.where(seg_start, ecs, -jnp.inf))
+        before_me = ecs - seg_base  # weight of earlier bidders on my node
+
+        cap_here = rem_cap[choice_s]
+        accept_s = active_s & (
+            (before_me + w_s <= cap_here) | (before_me == 0.0) & (cap_here > 0))
+
+        accept = jnp.zeros(p, jnp.bool_).at[perm].set(accept_s)
+        slot_assign = jnp.where(accept, choice, slot_assign)
+        unassigned = unassigned & ~accept
+
+        used_round = jnp.zeros(n, jnp.float32).at[choice].add(
+            jnp.where(accept, pweights, 0.0))
+        rem_cap = rem_cap - used_round
+        used = used + used_round
+
+        # Phase B — waterfall: stragglers rejected above would all rebid on
+        # the single cheapest node next round (converging linearly), so
+        # instead rank them by urgency and pour them into the remaining
+        # capacity of nodes ordered by price.  Hard-forbidden matches and
+        # rule-missing matches (when the partition still has rule-satisfying
+        # options) are skipped and retry next round.
+        price = used * price_scale
+        node_order = jnp.argsort(price)
+        # Clamp: the first-bidder progress rule can drive rem_cap negative
+        # (oversize partition into a capacity remainder); cum_rem must stay
+        # non-decreasing for searchsorted to be meaningful.
+        rem_sorted = jnp.maximum(rem_cap, 0.0)[node_order]
+        cum_rem = jnp.cumsum(rem_sorted)
+
+        straggler = active & ~accept
+        skey = jnp.where(straggler, -margin, jnp.inf)
+        sperm = jnp.argsort(skey, stable=True)
+        s_mask = straggler[sperm]
+        s_w = jnp.where(s_mask, pweights[sperm], 0.0)
+        s_excl = jnp.cumsum(s_w) - s_w
+        pos = jnp.searchsorted(cum_rem, s_excl + 0.5 * s_w, side="right")
+        in_range = pos < n
+        choice2 = node_order[jnp.clip(pos, 0, n - 1)]
+
+        raw2 = score[sperm, choice2]
+        raw_best = jnp.min(score, axis=1)[sperm]
+        hard_ok = raw2 < _INF / 2
+        soft_ok = (raw2 < _RULE_MISS / 2) | (raw_best >= _RULE_MISS / 2)
+        accept2_s = s_mask & in_range & hard_ok & soft_ok
+
+        accept2 = jnp.zeros(p, jnp.bool_).at[sperm].set(accept2_s)
+        choice2_un = jnp.zeros(p, jnp.int32).at[sperm].set(choice2)
+        slot_assign = jnp.where(accept2, choice2_un, slot_assign)
+        unassigned = unassigned & ~accept2
+
+        used2 = jnp.zeros(n, jnp.float32).at[choice2_un].add(
+            jnp.where(accept2, pweights, 0.0))
+        rem_cap = rem_cap - used2
+        used = used + used2
+
+        progress = jnp.any(accept | accept2)
+        return (slot_assign, unassigned, rem_cap, used, progress, it + 1)
+
+    def round_cond(carry):
+        _, unassigned, _, _, progress, it = carry
+        return jnp.any(unassigned) & progress & (it < _MAX_AUCTION_ROUNDS)
+
+    init = (
+        jnp.full(p, -1, jnp.int32),
+        jnp.ones(p, jnp.bool_),
+        cap,
+        jnp.zeros(n, jnp.float32),
+        jnp.array(True),
+        jnp.array(0, jnp.int32),
+    )
+    if axis_name:
+        # Freshly-created carries are axis-invariant until the (shard-local)
+        # loop body makes them varying; mark them varying up front so carry
+        # types agree.  Skip values that are already varying.
+        def ensure_varying(x):
+            vma = getattr(jax.typeof(x), "vma", frozenset())
+            return x if axis_name in vma else lax.pvary(x, (axis_name,))
+        init = tuple(ensure_varying(x) for x in init)
+    slot_assign, unassigned, _rem, used, _, _ = lax.while_loop(
+        round_cond, round_body, init)
+
+    # Force step: remaining partitions take their best feasible node,
+    # ignoring capacity (constraint satisfaction beats balance).
+    priced = score + (used * price_scale)[None, :]
+    best = jnp.min(priced, axis=1)
+    choice = jnp.argmin(priced, axis=1).astype(jnp.int32)
+    feasible = best < _INF / 2
+    forced = unassigned & feasible
+    slot_assign = jnp.where(forced, choice, slot_assign)
+    used_forced = jnp.zeros(n, jnp.float32).at[choice].add(
+        jnp.where(forced, pweights, 0.0))
+    used = used + used_forced
+
+    return slot_assign, used
+
+
+@partial(jax.jit, static_argnames=("constraints", "rules", "axis_name"))
+def solve_dense(
+    prev: jnp.ndarray,  # [P, S, R] int32
+    pweights: jnp.ndarray,  # [P] float32
+    nweights: jnp.ndarray,  # [N] float32
+    valid: jnp.ndarray,  # [N] bool
+    stickiness: jnp.ndarray,  # [P, S] float32
+    gids: jnp.ndarray,  # [L, N] int32
+    gid_valid: jnp.ndarray,  # [L, N] bool
+    constraints: tuple,  # static, per-state slot counts
+    rules: tuple,  # static, per-state tuple of (inc, exc) pairs
+    axis_name: Optional[str] = None,  # static; set under shard_map
+) -> jnp.ndarray:
+    """Solve the whole placement problem on device; returns assign[P, S, R]."""
+    p, s, r_max = prev.shape
+    n = nweights.shape[0]
+    if constraints and max(constraints) > r_max:
+        # JAX drops out-of-bounds scatter writes silently; without this the
+        # slots beyond R would vanish while still consuming capacity.
+        raise ValueError(
+            f"prev slot depth R={r_max} < max constraints {max(constraints)}")
+
+    total_p = _psum(jnp.array(p, jnp.float32), axis_name)
+    total_w = _psum(jnp.sum(pweights), axis_name)
+
+    w_div = jnp.where(nweights > 0, nweights, 1.0)
+    neg_boost = jnp.where(nweights < 0, -nweights, 0.0)  # [N]
+
+    # Jitter sits deliberately ABOVE the 0.001/P fill factor: the fill term
+    # is an advisory nudge (as in the reference's 0.001 filled-factor,
+    # plan.go:647-651), while real balance is owned by the capacity rail and
+    # the in-slot price.  Letting jitter dominate the fill signal keeps
+    # bids spread across near-equal nodes; herding bids by the fill
+    # ordering fragments capacity and forces cap overflows (measured: slot
+    # spread 12-20 vs 15-17 at 256x16).
+    jitter_scale = jnp.float32(_JITTER)
+
+    cap_w = jnp.where(valid, jnp.maximum(nweights, 1.0), 0.0)
+    cap_share = cap_w / jnp.maximum(jnp.sum(cap_w), 1.0)
+
+    # Seed the total-fill factor from prev (plan.go:94).  Per-state counts
+    # are NOT part of the batch score: every partition of a state reassigns
+    # simultaneously, so the state's own counts are zero at wave start, and
+    # carrying intra-wave counts across slots lets +-cap quantization noise
+    # (several units) swamp the 1.5 stickiness bonus and cause churn.  The
+    # capacity rail + in-slot price own balance instead.
+    total = jnp.sum(
+        jnp.stack([_scatter_counts(prev[:, si, :], pweights, n)
+                   for si in range(s)]), axis=0)
+    total = _psum(total, axis_name)
+
+    assign = jnp.full((p, s, r_max), -1, jnp.int32)
+    # Nodes already holding this partition at an equal-or-higher priority
+    # state in this pass (excludeHigherPriorityNodes, plan.go:146-156).
+    taken = jnp.zeros((p, n), jnp.bool_)
+
+    top_anchor = prev[:, 0, 0]  # previous primary, until slot (0,0) assigns
+
+    for si in range(s):
+        k = constraints[si]
+        if k <= 0:
+            continue
+
+        # All of this state's prev holders re-assign in this wave: remove
+        # their seed contribution up front (the batch analog of the
+        # per-partition decrement at plan.go:290-297).
+        state_prev = _psum(_scatter_counts(prev[:, si, :], pweights, n),
+                           axis_name)
+        total = total - state_prev
+
+        sticky_mask = _membership(prev[:, si, :], n)  # held this state before
+        sticky_bonus = stickiness[:, si][:, None] * sticky_mask
+
+        anchor = jnp.where(assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor) \
+            if si > 0 else top_anchor
+        hier = _hier_penalty(anchor, gids, gid_valid, rules[si]) \
+            if rules[si] else 0.0
+
+        for ri in range(k):
+            balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
+            score = balance / w_div[None, :]
+            # Same-ordinal alignment: slot ri mildly prefers prev slot ri's
+            # node (above jitter, below every real term), so sticky bids
+            # don't scramble ordinals and leftovers stay spread.
+            if ri < r_max:
+                score = score - 0.01 * _membership(prev[:, si, ri:ri + 1], n)
+            score = score + jnp.maximum(
+                neg_boost[None, :],
+                jnp.where(neg_boost[None, :] > 0,
+                          stickiness[:, si][:, None], 0.0))
+            score = score - sticky_bonus
+            score = score + hier
+            score = score + _INF * (taken | ~valid[None, :])
+
+            # Exact ceil capacity: the binding rail that yields tight
+            # balance; exclusivity stragglers rebid under the in-slot price
+            # and, in the worst case, the force step places them.
+            cap = jnp.ceil(total_w * cap_share)
+            if axis_name:
+                # Split each node's capacity into integral per-shard shares
+                # (fractional caps + the first-bidder progress rule would
+                # overshoot).  The remainder rotates with the node index so
+                # no shard systematically holds the extras.
+                ns = lax.axis_size(axis_name)
+                idx = lax.axis_index(axis_name)
+                base_cap = jnp.floor(cap / ns)
+                rem = cap - base_cap * ns
+                node_ids = jnp.arange(cap.shape[0], dtype=jnp.int32)
+                extra = ((node_ids + idx) % ns) < rem.astype(jnp.int32)
+                cap = base_cap + extra.astype(jnp.float32)
+
+            slot_assign, used = _assign_slot(
+                score, pweights, cap, 1.0 / w_div, jitter_scale, axis_name)
+            used = _psum(used, axis_name)  # global per-node accepted weight
+
+            assign = assign.at[:, si, ri].set(slot_assign)
+            total = total + used
+            safe_slot = _drop_empty(slot_assign, n)
+            taken = taken.at[jnp.arange(p), safe_slot].set(True, mode="drop")
+
+    return assign
+
+
+def check_assignment(
+    problem: DenseProblem, assign: np.ndarray
+) -> dict[str, int]:
+    """Constraint checker — the '0 violations' gate for the TPU backend.
+
+    Counts (a) slot shortfalls beyond what an honest solver could fill,
+    (b) same-partition node duplicates across states/slots, (c) assignments
+    to removed nodes.  Hierarchy-rule misses are reported separately (they
+    degrade softly, like the reference's warnings, when unmeetable)."""
+    assign = np.asarray(assign)
+    P, S, R = assign.shape
+    n_valid = int(problem.valid_node.sum())
+
+    dup = 0
+    removed = 0
+    shortfall = 0
+    for pi in range(P):
+        seen = set()
+        for si in range(S):
+            want = int(problem.constraints[si])
+            got = 0
+            for ri in range(R):
+                node = int(assign[pi, si, ri])
+                if node < 0:
+                    continue
+                got += 1
+                if node in seen:
+                    dup += 1
+                seen.add(node)
+                if not problem.valid_node[node]:
+                    removed += 1
+            if want > 0:
+                achievable = min(want, max(n_valid - len(seen) + got, 0))
+                if got < min(want, achievable):
+                    shortfall += min(want, achievable) - got
+    return {"duplicates": dup, "on_removed_nodes": removed,
+            "unfilled_feasible_slots": shortfall}
+
+
+def plan_next_map_tpu(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    nodes_to_add: Optional[list[str]],
+    model: PartitionModel,
+    opts: Optional[PlanOptions] = None,
+) -> tuple[PartitionMap, dict[str, list[str]]]:
+    """TPU-backed equivalent of plan_next_map_greedy: one global batched
+    solve instead of a sequential pass.  Same inputs/outputs; nodes_to_add
+    is implicit (fresh nodes simply have zero counts, which attracts load)."""
+    opts = opts or PlanOptions()
+    del nodes_to_add
+
+    problem = encode_problem(
+        prev_map, partitions_to_assign, nodes_all, nodes_to_remove, model, opts)
+    if problem.P == 0 or problem.N == 0 or problem.S == 0:
+        return decode_assignment(
+            problem,
+            np.full((problem.P, problem.S, max(problem.R, 1)), -1, np.int32),
+            partitions_to_assign, nodes_to_remove)
+
+    rules = tuple(
+        tuple(problem.rules.get(si, ())) for si in range(problem.S))
+    constraints = tuple(int(c) for c in problem.constraints)
+
+    assign = solve_dense(
+        jnp.asarray(problem.prev),
+        jnp.asarray(problem.partition_weights),
+        jnp.asarray(problem.node_weights),
+        jnp.asarray(problem.valid_node),
+        jnp.asarray(problem.stickiness),
+        jnp.asarray(problem.gids),
+        jnp.asarray(problem.gid_valid),
+        constraints,
+        rules,
+    )
+    return decode_assignment(
+        problem, np.asarray(assign), partitions_to_assign, nodes_to_remove)

@@ -9,7 +9,7 @@ in bench.py, not in the test suite.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin overrides JAX_PLATFORMS from the environment, so pin
+# the platform through the config API as well (must happen before any
+# computation runs).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
