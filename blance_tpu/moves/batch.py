@@ -1,0 +1,230 @@
+"""Batched on-device move calculus: diff whole maps at once.
+
+The host-side calc_partition_moves (moves/calc.py, reference moves.go:41-119)
+is O(S^2 R^2) per partition with tiny constants — fine for one partition,
+slow in Python for 100k.  This module computes the SAME ordered op lists for
+every partition in one jitted computation over dense assignments:
+
+Each node involved in a partition has exactly one (beg_state, end_state)
+pair, which determines its op:
+  beg absent          -> add     (at end state)
+  end absent          -> del     (emitted at beg state's turn)
+  beg_state >  end    -> promote (moving up; emitted at end state's turn)
+  beg_state <  end    -> demote  (moving down; emitted at end state's turn)
+and an ordering key replicating the reference's two emission orders
+(availability-first: promote, demote, add, del per state superior-first;
+min-copies-first: del, demote, promote, add per state inferior-first), with
+ties following slot order within a state.
+
+Op codes: 0=add 1=del 2=promote 3=demote; -1 = empty.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import PartitionMap, PartitionModel
+from .calc import NodeStateOp
+
+__all__ = ["diff_assignments", "calc_all_moves", "OP_NAMES"]
+
+OP_NAMES = ["add", "del", "promote", "demote"]
+_OP_ADD, _OP_DEL, _OP_PROMOTE, _OP_DEMOTE = 0, 1, 2, 3
+
+
+def _state_of(assign: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[P, S, R] slots -> [P, N] state index holding each node, -1 if none.
+
+    If a node somehow appears in several states, the highest-priority
+    (lowest index) wins, matching the reference's superior-first scans.
+    """
+    p, s, _r = assign.shape
+    out = jnp.full((p, n), jnp.int32(s))
+    # Iterate states inferior-first so superior states overwrite.
+    for si in range(s - 1, -1, -1):
+        ids = assign[:, si, :]
+        safe = jnp.where(ids >= 0, ids, n)
+        out = out.at[jnp.arange(p)[:, None], safe].min(
+            jnp.full_like(ids, si), mode="drop")
+    return jnp.where(out == s, -1, out).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n", "favor_min_nodes"))
+def diff_assignments(
+    beg: jnp.ndarray,  # [P, S, R] int32 node ids
+    end: jnp.ndarray,  # [P, S, R] int32 node ids
+    n: int,  # node count
+    favor_min_nodes: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Diff two dense assignments into ordered per-partition op lists.
+
+    Returns (nodes[P, L], states[P, L], ops[P, L]) with -1 padding at the
+    tail; L = 2*S*R.  states[i] is -1 for del ops (the reference's "" state).
+    """
+    p, s, r = beg.shape
+    L = 2 * s * r
+
+    beg_state = _state_of(beg, n)  # [P, N]
+    end_state = _state_of(end, n)
+
+    def op_and_key(b, e):
+        """Op code + emission key for one (beg_state, end_state) pair."""
+        is_add = (b < 0) & (e >= 0)
+        is_del = (b >= 0) & (e < 0)
+        is_pro = (b >= 0) & (e >= 0) & (b > e)
+        is_dem = (b >= 0) & (e >= 0) & (b < e)
+        op = jnp.where(is_add, _OP_ADD,
+             jnp.where(is_del, _OP_DEL,
+             jnp.where(is_pro, _OP_PROMOTE,
+             jnp.where(is_dem, _OP_DEMOTE, -1))))
+        # Emission state: the end state's turn, except del at the beg state.
+        emit_state = jnp.where(is_del, b, e)
+        if not favor_min_nodes:
+            rank = jnp.where(is_pro, 0,
+                   jnp.where(is_dem, 1,
+                   jnp.where(is_add, 2, 3)))
+            key = emit_state * 4 + rank
+        else:
+            rank = jnp.where(is_del, 0,
+                   jnp.where(is_dem, 1,
+                   jnp.where(is_pro, 2, 3)))
+            key = (s - 1 - emit_state) * 4 + rank
+        return op, key
+
+    # Gather per-entry info from the end side (promote/demote/add) and the
+    # beg side (del).  Each real node appears on exactly one side's slots
+    # unless unchanged (same state -> no op).
+    entries_node = []
+    entries_state = []
+    entries_op = []
+    entries_key = []
+
+    def add_entries(slots, side_is_end):
+        for si in range(s):
+            for ri in range(r):
+                node = slots[:, si, ri]
+                valid = node >= 0
+                safe = jnp.clip(node, 0, n - 1)
+                b = jnp.where(valid, beg_state[jnp.arange(p), safe], -1)
+                e = jnp.where(valid, end_state[jnp.arange(p), safe], -1)
+                op, key = op_and_key(b, e)
+                if side_is_end:
+                    keep = valid & (op >= 0) & (op != _OP_DEL)
+                else:
+                    keep = valid & (op == _OP_DEL)
+                # Slot order breaks ties within (state, rank).
+                full_key = jnp.where(keep, key * (r + 1) + ri, jnp.int32(2**30))
+                out_state = jnp.where(op == _OP_DEL, -1, e)
+                entries_node.append(jnp.where(keep, node, -1))
+                entries_state.append(jnp.where(keep, out_state, -1))
+                entries_op.append(jnp.where(keep, op, -1))
+                entries_key.append(full_key)
+
+    add_entries(end, True)
+    add_entries(beg, False)
+
+    nodes = jnp.stack(entries_node, axis=1)  # [P, 2*S*R]
+    states = jnp.stack(entries_state, axis=1)
+    ops = jnp.stack(entries_op, axis=1)
+    keys = jnp.stack(entries_key, axis=1)
+
+    order = jnp.argsort(keys, axis=1)
+    take = jnp.take_along_axis
+    return (take(nodes, order, 1)[:, :L],
+            take(states, order, 1)[:, :L],
+            take(ops, order, 1)[:, :L])
+
+
+def calc_all_moves(
+    beg_map: PartitionMap,
+    end_map: PartitionMap,
+    model: PartitionModel,
+    favor_min_nodes: bool = False,
+) -> dict[str, list[NodeStateOp]]:
+    """Whole-map diff on device; returns per-partition ordered op lists.
+
+    Produces the same ops as running calc_partition_moves per partition
+    (cross-checked in tests); use this for 100k-partition rebalances where
+    the host loop is the bottleneck.
+    """
+    from ..plan.greedy import sort_state_names
+
+    states = sort_state_names(model)
+    state_index = {sname: i for i, sname in enumerate(states)}
+
+    names = sorted(beg_map.keys())
+    nodes: list[str] = []
+    node_index: dict[str, int] = {}
+
+    def intern(node: str) -> int:
+        if node not in node_index:
+            node_index[node] = len(nodes)
+            nodes.append(node)
+        return node_index[node]
+
+    r_max = 1
+    for m in (beg_map, end_map):
+        for partition in m.values():
+            for sname, ns in partition.nodes_by_state.items():
+                if sname in state_index:
+                    r_max = max(r_max, len(ns))
+
+    P, S = len(names), len(states)
+    beg = np.full((P, S, r_max), -1, np.int32)
+    end = np.full((P, S, r_max), -1, np.int32)
+    # Partitions where a node appears in more than one state on either side
+    # need the host diff: the reference's per-state scan + seen-set has
+    # order-dependent behavior there that the dense one-state-per-node
+    # encoding cannot express (moves.go:49-58).
+    irregular: set[str] = set()
+    for pi, name in enumerate(names):
+        for arr, m in ((beg, beg_map), (end, end_map)):
+            partition = m.get(name)
+            if partition is None:
+                continue
+            seen_nodes: set[str] = set()
+            for sname, ns in partition.nodes_by_state.items():
+                si = state_index.get(sname)
+                if si is None:
+                    continue
+                for ri, node in enumerate(ns[:r_max]):
+                    if node in seen_nodes:
+                        irregular.add(name)
+                    seen_nodes.add(node)
+                    arr[pi, si, ri] = intern(node)
+
+    if P == 0 or not nodes:
+        return {name: [] for name in names}
+
+    d_nodes, d_states, d_ops = diff_assignments(
+        jnp.asarray(beg), jnp.asarray(end), len(nodes), favor_min_nodes)
+    d_nodes = np.asarray(d_nodes)
+    d_states = np.asarray(d_states)
+    d_ops = np.asarray(d_ops)
+
+    from .calc import calc_partition_moves
+
+    out: dict[str, list[NodeStateOp]] = {}
+    for pi, name in enumerate(names):
+        if name in irregular:
+            out[name] = calc_partition_moves(
+                states,
+                beg_map[name].nodes_by_state if name in beg_map else {},
+                end_map[name].nodes_by_state if name in end_map else {},
+                favor_min_nodes)
+            continue
+        moves = []
+        for li in range(d_nodes.shape[1]):
+            op = int(d_ops[pi, li])
+            if op < 0:
+                continue
+            node = nodes[int(d_nodes[pi, li])]
+            sname = "" if int(d_states[pi, li]) < 0 else states[int(d_states[pi, li])]
+            moves.append(NodeStateOp(node, sname, OP_NAMES[op]))
+        out[name] = moves
+    return out

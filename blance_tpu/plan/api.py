@@ -4,9 +4,12 @@
 (reference: /root/reference/api.go:147-157).  Backends:
 
 - "greedy": the exact sequential planner (semantics oracle; plan/greedy.py).
+- "native": the same exact algorithm with the hot loop in C++ (plan/native.py
+            + native/planner.cpp) — bit-identical results, ~100x throughput;
+            falls back to "greedy" when unsupported hooks are in play.
 - "tpu":    the batched cost-tensor planner (plan/tensor.py) — whole-problem
             scoring on device, constraint repair, sharded over partitions.
-- "auto":   "tpu" for large problems, "greedy" otherwise.
+- "auto":   "tpu" for large problems, "native" (or "greedy") otherwise.
 """
 
 from __future__ import annotations
@@ -45,10 +48,16 @@ def plan_next_map(
 
     if backend == "auto":
         size = len(partitions_to_assign) * len(nodes_all)
-        backend = "tpu" if size >= _AUTO_TPU_THRESHOLD else "greedy"
+        backend = "tpu" if size >= _AUTO_TPU_THRESHOLD else "native"
 
     if backend == "greedy":
         return plan_next_map_greedy(
+            prev_map, partitions_to_assign, nodes_all,
+            nodes_to_remove, nodes_to_add, model, opts)
+    if backend == "native":
+        from .native import plan_next_map_native  # deferred: may compile
+
+        return plan_next_map_native(
             prev_map, partitions_to_assign, nodes_all,
             nodes_to_remove, nodes_to_add, model, opts)
     if backend == "tpu":

@@ -198,6 +198,12 @@ def _partition_name_key(name: str) -> str:
     return f"{n:>10d}"
 
 
+def _partition_weight_key(weight: int) -> str:
+    """Heavier-first sortable weight key (plan.go:533-539); shared with the
+    native backend's static rank so the encodings cannot drift."""
+    return f"{999999999 - weight:>10d}"
+
+
 def _partition_sort_score(
     partition: Partition,
     state_name: str,
@@ -213,7 +219,7 @@ def _partition_sort_score(
     weight = 1
     if partition_weights is not None:
         weight = partition_weights.get(partition.name, 1)
-    weight_key = f"{999999999 - weight:>10d}"  # heavier first
+    weight_key = _partition_weight_key(weight)
 
     # Category 0: partitions whose previous holders of this state sit on
     # to-be-removed nodes (plan.go:541-550).

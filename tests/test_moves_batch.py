@@ -1,0 +1,75 @@
+"""Batched on-device move diff must agree exactly with the host
+calc_partition_moves on randomized maps, in both orderings."""
+
+import random
+
+from blance_tpu import Partition, calc_partition_moves, model
+from blance_tpu.moves.batch import calc_all_moves
+from blance_tpu.plan.greedy import sort_state_names
+
+M = model(primary=(0, 1), replica=(1, 2))
+
+
+def random_maps(seed, n_partitions=40, n_nodes=8):
+    rng = random.Random(seed)
+    nodes = [f"n{i}" for i in range(n_nodes)]
+
+    def random_nbs():
+        pool = rng.sample(nodes, rng.randint(0, 5))
+        n_primary = rng.randint(0, min(1, len(pool)))
+        return {
+            "primary": pool[:n_primary],
+            "replica": pool[n_primary:],
+        }
+
+    beg = {str(i): Partition(str(i), random_nbs()) for i in range(n_partitions)}
+    end = {str(i): Partition(str(i), random_nbs()) for i in range(n_partitions)}
+    return beg, end
+
+
+def test_batch_diff_matches_host_diff():
+    states = sort_state_names(M)
+    for seed in range(6):
+        beg, end = random_maps(seed)
+        for favor_min in (False, True):
+            batched = calc_all_moves(beg, end, M, favor_min)
+            for name in beg:
+                host = calc_partition_moves(
+                    states,
+                    beg[name].nodes_by_state,
+                    end[name].nodes_by_state,
+                    favor_min,
+                )
+                assert batched[name] == host, (
+                    f"seed {seed} favor_min {favor_min} partition {name}:\n"
+                    f"beg {beg[name].nodes_by_state}\n"
+                    f"end {end[name].nodes_by_state}\n"
+                    f"batched {batched[name]}\nhost {host}")
+
+
+def test_batch_diff_multi_state_nodes_fall_back_to_host():
+    states = sort_state_names(M)
+    cases = [
+        # Node gains a second state: host emits one add (availability) /
+        # keeps per-scan-order semantics (min-nodes).
+        ({}, {"primary": ["a"], "replica": ["a"]}),
+        # Node keeps primary while also appearing as replica: host emits a
+        # demote even though primary persists.
+        ({"primary": ["a"]}, {"primary": ["a"], "replica": ["a"]}),
+        # Duplicate within beg.
+        ({"primary": ["a"], "replica": ["a"]}, {"replica": ["a"]}),
+    ]
+    for beg_nbs, end_nbs in cases:
+        beg = {"x": Partition("x", dict(beg_nbs))}
+        end = {"x": Partition("x", dict(end_nbs))}
+        for favor_min in (False, True):
+            host = calc_partition_moves(states, beg_nbs, end_nbs, favor_min)
+            batched = calc_all_moves(beg, end, M, favor_min)
+            assert batched["x"] == host, (beg_nbs, end_nbs, favor_min)
+
+
+def test_batch_diff_empty_and_noop():
+    beg = {"x": Partition("x", {"primary": ["a"]})}
+    end = {"x": Partition("x", {"primary": ["a"]})}
+    assert calc_all_moves(beg, end, M) == {"x": []}
+    assert calc_all_moves({}, {}, M) == {}

@@ -1,0 +1,148 @@
+"""Native (C++) backend parity: must be bit-identical to the Python greedy.
+
+Covers the 20 golden struct cases plus randomized differential testing over
+weights, stickiness, hierarchies, node adds/removes and prev maps.
+"""
+
+import random
+
+import pytest
+
+from blance_tpu import (
+    HierarchyRule,
+    Partition,
+    PlanOptions,
+    model,
+    plan_next_map,
+)
+from blance_tpu.plan.native import cbgt_node_score_booster, native_available
+from tests.test_plan import CASES, pm
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable")
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c["about"] for c in CASES])
+def test_native_matches_golden_cases(case):
+    opts = PlanOptions(
+        model_state_constraints=case.get("constraints"),
+        partition_weights=case.get("pweights"),
+        state_stickiness=case.get("sstick"),
+        node_weights=case.get("nweights"),
+        node_hierarchy=case.get("hierarchy"),
+        hierarchy_rules=case.get("rules"),
+    )
+    result, warnings = plan_next_map(
+        pm(case["prev"]), pm(case["assign"]), case["nodes"],
+        case["remove"], case["add"], case["model"], opts,
+        backend="native",
+    )
+    got = {name: p.nodes_by_state for name, p in result.items()}
+    assert got == {name: dict(nbs) for name, nbs in case["exp"].items()}
+    assert sum(len(w) for w in warnings.values()) == case["warnings"]
+
+
+def _random_scenario(rng: random.Random):
+    n_nodes = rng.randint(1, 10)
+    nodes = [f"n{i}" for i in range(n_nodes)]
+    hierarchy = None
+    rules = None
+    if rng.random() < 0.5:
+        n_racks = rng.randint(1, 3)
+        hierarchy = {n: f"r{i % n_racks}" for i, n in enumerate(nodes)}
+        hierarchy.update({f"r{i}": "z0" for i in range(n_racks)})
+        rules = {"replica": [HierarchyRule(rng.choice([1, 2]),
+                                           rng.choice([0, 1]))]}
+    m = model(primary=(0, rng.randint(1, 2)), replica=(1, rng.randint(0, 2)))
+    n_parts = rng.randint(1, 24)
+    names = [str(i) for i in range(n_parts)]
+
+    def random_map(assigned: bool):
+        out = {}
+        for name in names:
+            nbs: dict = {}
+            if assigned:
+                pool = rng.sample(nodes, min(len(nodes), rng.randint(0, 3)))
+                cut = rng.randint(0, len(pool))
+                nbs = {"primary": pool[:cut], "replica": pool[cut:]}
+            out[name] = Partition(name, nbs)
+        return out
+
+    prev = random_map(rng.random() < 0.7)
+    assign = (random_map(True) if rng.random() < 0.2
+              else {k: v.copy() for k, v in prev.items()})
+    removes = rng.sample(nodes, rng.randint(0, max(0, n_nodes - 1)))
+    adds = None if rng.random() < 0.3 else rng.sample(nodes, rng.randint(0, n_nodes))
+
+    opts = PlanOptions(
+        partition_weights=(
+            {rng.choice(names): rng.randint(1, 5)} if rng.random() < 0.4 else None),
+        state_stickiness=(
+            {"primary": rng.randint(1, 100)} if rng.random() < 0.4 else None),
+        node_weights=(
+            {rng.choice(nodes): rng.choice([-2, -1, 2, 3])}
+            if rng.random() < 0.4 else None),
+        node_hierarchy=hierarchy,
+        hierarchy_rules=rules,
+        node_score_booster=(
+            cbgt_node_score_booster if rng.random() < 0.5 else None),
+    )
+    return prev, assign, nodes, removes, adds, m, opts
+
+
+def test_native_ghost_nodes_match_greedy():
+    """Partitions referencing nodes outside nodes_all (not removed either)
+    must behave identically: the ghost stays in rows and accounting but is
+    never a candidate."""
+    m = model(primary=(0, 1), replica=(1, 1))
+    prev = {
+        "0": Partition("0", {"primary": ["ghost"], "replica": ["a"]}),
+        "1": Partition("1", {"primary": ["b"], "replica": ["ghost"]}),
+        "2": Partition("2", {"primary": ["a"], "replica": ["b"]}),
+    }
+    for constraints in (None, {"primary": 1, "replica": 0}):
+        opts = PlanOptions(model_state_constraints=constraints)
+        g_map, g_w = plan_next_map(prev, prev, ["a", "b"], [], None, m, opts,
+                                   backend="greedy")
+        n_map, n_w = plan_next_map(prev, prev, ["a", "b"], [], None, m, opts,
+                                   backend="native")
+        assert {k: p.nodes_by_state for k, p in n_map.items()} == \
+               {k: p.nodes_by_state for k, p in g_map.items()}
+        assert n_w == g_w
+
+
+def test_native_interior_hierarchy_node_matches_greedy():
+    """A listed node that is also a hierarchy parent is never a valid
+    hierarchy pick (find_leaves yields leaves only)."""
+    m = model(primary=(0, 1), replica=(1, 1))
+    parts = {str(i): Partition(str(i), {}) for i in range(4)}
+    opts = PlanOptions(
+        node_hierarchy={"a": "r0", "b": "r0", "r0": "z0"},
+        hierarchy_rules={"replica": [HierarchyRule(1, 0)]},
+    )
+    nodes = ["a", "b", "r0"]  # r0 is both a node and a's/b's parent
+    g_map, g_w = plan_next_map({}, parts, nodes, [], nodes, m, opts,
+                               backend="greedy")
+    n_map, n_w = plan_next_map({}, parts, nodes, [], nodes, m, opts,
+                               backend="native")
+    assert {k: p.nodes_by_state for k, p in n_map.items()} == \
+           {k: p.nodes_by_state for k, p in g_map.items()}
+    assert n_w == g_w
+
+
+def test_native_differential_vs_greedy():
+    rng = random.Random(1234)
+    for trial in range(60):
+        prev, assign, nodes, removes, adds, m, opts = _random_scenario(rng)
+        g_map, g_warn = plan_next_map(
+            prev, assign, nodes, removes, adds, m, opts, backend="greedy")
+        n_map, n_warn = plan_next_map(
+            prev, assign, nodes, removes, adds, m, opts, backend="native")
+        g = {k: p.nodes_by_state for k, p in g_map.items()}
+        n = {k: p.nodes_by_state for k, p in n_map.items()}
+        assert n == g, (
+            f"trial {trial}: mismatch\nnodes {nodes} removes {removes} "
+            f"adds {adds}\nopts {opts}\nprev "
+            f"{ {k: p.nodes_by_state for k, p in prev.items()} }\n"
+            f"greedy {g}\nnative {n}")
+        assert n_warn == g_warn, f"trial {trial}: warnings {n_warn} != {g_warn}"
