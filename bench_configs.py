@@ -1,0 +1,131 @@
+"""Measure the five BASELINE.json configs: native C++ CPU planner vs the
+batched TPU solver, plus the delta-rebalance churn metric.
+
+Usage: python bench_configs.py [--json out.json]
+
+Unlike bench.py (the driver's single-line benchmark), this is the full
+baseline table generator for BASELINE.md.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+import blance_tpu as bt
+from blance_tpu.moves.batch import calc_all_moves
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_cluster(P, N, model, rng, weights=False, racks=0):
+    nodes = [f"n{i:05d}" for i in range(N)]
+    parts = {str(i): bt.Partition(str(i), {}) for i in range(P)}
+    opts_kwargs = {}
+    if weights:
+        opts_kwargs["partition_weights"] = {
+            str(i): int(rng.integers(1, 5)) for i in range(0, P, 7)}
+        opts_kwargs["node_weights"] = {
+            nodes[i]: int(rng.integers(1, 4)) for i in range(0, N, 5)}
+        opts_kwargs["state_stickiness"] = {"primary": 100}
+    if racks:
+        hier = {n: f"r{i % racks}" for i, n in enumerate(nodes)}
+        hier.update({f"r{i}": "z0" for i in range(racks)})
+        opts_kwargs["node_hierarchy"] = hier
+        opts_kwargs["hierarchy_rules"] = {
+            "replica": [bt.HierarchyRule(2, 1)]}
+    return nodes, parts, bt.PlanOptions(**opts_kwargs)
+
+
+def time_backend(backend, prev, parts, nodes, removes, adds, model, opts,
+                 repeats=1):
+    best = None
+    result = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = bt.plan_next_map(prev, parts, nodes, removes, adds, model,
+                                  opts, backend=backend)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best, result
+
+
+def run_config(name, P, N, model, rng, weights=False, racks=0,
+               delta=0.0, skip_cpu=False, tpu_repeats=2):
+    nodes, parts, opts = make_cluster(P, N, model, rng, weights, racks)
+    empty = {k: v.copy() for k, v in parts.items()}
+
+    # Warm prev map via the TPU backend (also warms the jit cache).
+    _, (prev, _w) = time_backend("tpu", empty, parts, nodes, [], nodes,
+                                 model, opts)
+
+    removes, adds = [], []
+    if delta:
+        k = int(N * delta)
+        removes = list(rng.choice(nodes, k, replace=False))
+        adds = None
+
+    row = {"config": name, "P": P, "N": N}
+
+    t_tpu, (tpu_map, tpu_warn) = time_backend(
+        "tpu", prev, prev, nodes, removes, adds, model, opts,
+        repeats=tpu_repeats)
+    row["tpu_s"] = round(t_tpu, 4)
+    row["tpu_warnings"] = sum(len(v) for v in tpu_warn.values())
+
+    if not skip_cpu:
+        t_cpu, (cpu_map, _) = time_backend(
+            "native", prev, prev, nodes, removes, adds, model, opts)
+        row["cpu_native_s"] = round(t_cpu, 4)
+        row["speedup"] = round(t_cpu / t_tpu, 1)
+
+    if delta:
+        t0 = time.perf_counter()
+        moves = calc_all_moves(prev, tpu_map, model)
+        row["diff_s"] = round(time.perf_counter() - t0, 3)
+        total_ops = sum(len(v) for v in moves.values())
+        # Lower bound: copies on removed nodes must move (one op each) and
+        # pair with an add.
+        displaced = sum(
+            1 for p in prev.values() for ns in p.nodes_by_state.values()
+            for n in ns if n in set(removes))
+        row["churn_ops"] = total_ops
+        row["churn_lower_bound"] = 2 * displaced
+    log(f"{name}: {row}")
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    rng = np.random.default_rng(0)
+
+    m_1p1r = bt.model(primary=(0, 1), replica=(1, 1))
+    m_1p2r = bt.model(primary=(0, 1), replica=(1, 2))
+    m_multi = bt.model(primary=(0, 2), replica=(1, 1), read_only=(2, 1))
+
+    rows = [
+        run_config("1: 1024x8 primary+1 replica flat",
+                   1024, 8, m_1p1r, rng),
+        run_config("2: 4096x64 primary+2 replicas rack/zone rules",
+                   4096, 64, m_1p2r, rng, racks=8),
+        run_config("3: heterogeneous weights+stickiness 16k x 256",
+                   16384, 256, m_1p1r, rng, weights=True),
+        run_config("4: multi-primary + read-only 100k x 1k",
+                   100_000, 1000, m_multi, rng),
+        run_config("5: delta rebalance -20% of 10k nodes, churn",
+                   32_768, 10_000, m_1p1r, rng, delta=0.2),
+    ]
+    print(json.dumps(rows, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

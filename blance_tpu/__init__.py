@@ -33,7 +33,14 @@ from .core.setops import (
     strings_to_set,
 )
 from .moves.calc import NodeStateOp, calc_partition_moves
-from .plan.api import plan_next_map
+from .plan.api import plan_next_map, plan_next_map_legacy
+from .rebalance import (
+    RebalanceResult,
+    load_partition_map,
+    rebalance,
+    rebalance_async,
+    save_partition_map,
+)
 from .plan.greedy import (
     NodeScoreContext,
     count_state_nodes,
@@ -65,6 +72,12 @@ __all__ = [
     "partition_map_to_json",
     "plan_next_map",
     "plan_next_map_greedy",
+    "plan_next_map_legacy",
+    "RebalanceResult",
+    "load_partition_map",
+    "rebalance",
+    "rebalance_async",
+    "save_partition_map",
     "sort_state_names",
     "strings_dedup",
     "strings_intersect",

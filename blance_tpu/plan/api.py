@@ -19,7 +19,7 @@ from typing import Optional
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
 from .greedy import plan_next_map_greedy
 
-__all__ = ["plan_next_map"]
+__all__ = ["plan_next_map", "plan_next_map_legacy"]
 
 # Below this many (partitions x nodes), the exact greedy is faster than a
 # device round-trip; above it, the batched solver wins.
@@ -67,3 +67,36 @@ def plan_next_map(
             prev_map, partitions_to_assign, nodes_all,
             nodes_to_remove, nodes_to_add, model, opts)
     raise ValueError(f"unknown backend: {backend!r}")
+
+
+def plan_next_map_legacy(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    nodes_to_add: Optional[list[str]],
+    model: PartitionModel,
+    model_state_constraints: Optional[dict[str, int]] = None,
+    partition_weights: Optional[dict[str, int]] = None,
+    state_stickiness: Optional[dict[str, int]] = None,
+    node_weights: Optional[dict[str, int]] = None,
+    node_hierarchy: Optional[dict[str, str]] = None,
+    hierarchy_rules=None,
+    backend: str = "greedy",
+) -> tuple[PartitionMap, dict[str, list[str]]]:
+    """Positional-options compatibility shim mirroring the reference's
+    deprecated PlanNextMap signature (api.go:109-132); prefer plan_next_map
+    with PlanOptions."""
+    return plan_next_map(
+        prev_map, partitions_to_assign, nodes_all,
+        nodes_to_remove, nodes_to_add, model,
+        PlanOptions(
+            model_state_constraints=model_state_constraints,
+            partition_weights=partition_weights,
+            state_stickiness=state_stickiness,
+            node_weights=node_weights,
+            node_hierarchy=node_hierarchy,
+            hierarchy_rules=hierarchy_rules,
+        ),
+        backend=backend,
+    )

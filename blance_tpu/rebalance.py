@@ -1,0 +1,133 @@
+"""App-level rebalance facade: plan -> diff -> orchestrate in one call.
+
+The reference leaves this composition to the application (SURVEY.md §3.4:
+plan or hand-build the end map, call OrchestrateMoves, drain ProgressCh,
+Stop).  This module packages the canonical wiring, with the checkpoint
+story built in: the PartitionMap IS the checkpoint (JSON-serializable by
+design, reference api.go:30-35), so a crashed rebalance resumes by
+re-planning from the current map and orchestrating the remaining diff —
+the planner is pure and idempotent at fixpoint (plan_test.go:1888-1908).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .core.types import (
+    PartitionMap,
+    PartitionModel,
+    PlanOptions,
+    partition_map_from_json,
+    partition_map_to_json,
+)
+from .orchestrate.orchestrator import (
+    FindMoveFunc,
+    OrchestratorOptions,
+    OrchestratorProgress,
+    lowest_weight_partition_move_for_node,
+    orchestrate_moves,
+)
+from .plan.api import plan_next_map
+from .utils.trace import PhaseTimer
+
+__all__ = [
+    "RebalanceResult",
+    "rebalance",
+    "rebalance_async",
+    "save_partition_map",
+    "load_partition_map",
+]
+
+
+@dataclass
+class RebalanceResult:
+    """Everything a caller needs after a full rebalance."""
+
+    next_map: PartitionMap
+    warnings: dict[str, list[str]]
+    progress: OrchestratorProgress
+    progress_events: int
+    timer: PhaseTimer = field(default_factory=PhaseTimer)
+
+
+def save_partition_map(pmap: PartitionMap, path: str) -> None:
+    """Checkpoint a map as JSON (atomic rename)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(partition_map_to_json(pmap), f)
+    os.replace(tmp, path)
+
+
+def load_partition_map(path: str) -> PartitionMap:
+    with open(path) as f:
+        return partition_map_from_json(json.load(f))
+
+
+async def rebalance_async(
+    model: PartitionModel,
+    current_map: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    nodes_to_add: Optional[list[str]],
+    assign_partitions,
+    *,
+    plan_options: Optional[PlanOptions] = None,
+    orchestrator_options: Optional[OrchestratorOptions] = None,
+    find_move: Optional[FindMoveFunc] = None,
+    backend: str = "auto",
+    on_progress: Optional[Callable[[OrchestratorProgress], None]] = None,
+    checkpoint_path: Optional[str] = None,
+) -> RebalanceResult:
+    """Plan the next map and execute the transition against the callback.
+
+    assign_partitions(stop_ch, node, partitions, states, ops) is the app's
+    data plane (sync or async).  on_progress sees every progress snapshot.
+    checkpoint_path, if set, saves the target map before orchestration and
+    the achieved map after.
+    """
+    timer = PhaseTimer()
+    with timer.phase("plan"):
+        next_map, warnings = plan_next_map(
+            current_map, current_map, nodes_all,
+            nodes_to_remove, nodes_to_add, model,
+            plan_options, backend=backend)
+
+    if checkpoint_path:
+        with timer.phase("checkpoint"):
+            save_partition_map(next_map, checkpoint_path)
+
+    events = 0
+    with timer.phase("orchestrate"):
+        o = orchestrate_moves(
+            model,
+            orchestrator_options or OrchestratorOptions(),
+            nodes_all,
+            current_map,
+            next_map,
+            assign_partitions,
+            find_move or lowest_weight_partition_move_for_node,
+        )
+        final = OrchestratorProgress()
+        async for progress in o.progress_ch():
+            events += 1
+            final = progress
+            if on_progress is not None:
+                on_progress(progress)
+        o.stop()
+
+    return RebalanceResult(
+        next_map=next_map,
+        warnings=warnings,
+        progress=final,
+        progress_events=events,
+        timer=timer,
+    )
+
+
+def rebalance(*args, **kwargs) -> RebalanceResult:
+    """Synchronous wrapper around rebalance_async (runs its own loop)."""
+    return asyncio.run(rebalance_async(*args, **kwargs))

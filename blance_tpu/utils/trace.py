@@ -1,0 +1,71 @@
+"""Lightweight tracing/profiling for planner and orchestrator phases.
+
+The reference has no tracing (SURVEY.md §5); its observability surface is
+the orchestrator progress stream.  Here, in addition to that stream, the
+framework exposes:
+
+- ``PhaseTimer``: nested wall-clock phase timing with a queryable report —
+  used by the planning facade to attribute time to encode / solve / decode.
+- ``device_profile``: context manager around jax.profiler.trace for real
+  TPU traces (viewable in TensorBoard / Perfetto), no-op if profiling is
+  unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+__all__ = ["PhaseTimer", "device_profile"]
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates wall-clock per named phase; phases may repeat."""
+
+    totals: dict[str, float] = field(default_factory=dict)
+    counts: dict[str, int] = field(default_factory=dict)
+    _stack: list[tuple[str, float]] = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        self._stack.append((name, start))
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            elapsed = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + elapsed
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> dict[str, dict[str, float]]:
+        return {
+            name: {"total_s": self.totals[name], "count": self.counts[name]}
+            for name in self.totals
+        }
+
+    def __str__(self) -> str:
+        parts = [
+            f"{name}: {self.totals[name]*1000:.1f}ms x{self.counts[name]}"
+            for name in sorted(self.totals, key=self.totals.get, reverse=True)
+        ]
+        return "; ".join(parts)
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace wrapper; inert when log_dir is None or the
+    profiler can't start (e.g. no device)."""
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax
+
+        with jax.profiler.trace(log_dir):
+            yield
+    except Exception:
+        yield

@@ -1,0 +1,135 @@
+"""Concurrency-ordering stress tests for the orchestrator.
+
+The reference relies on `go test -race` plus channel discipline; the
+asyncio analog (SURVEY.md §5) is hammering pause/resume/stop orderings and
+interleavings against invariants:
+
+- the progress stream always closes,
+- counters are monotonic and pause/resume counts stay balanced,
+- every executed op is one the move plan allows, in per-partition order,
+- stop() mid-flight never hangs and never loses in-flight completions.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from blance_tpu import Partition, PartitionModelState
+from blance_tpu.orchestrate import OrchestratorOptions, orchestrate_moves
+
+MODEL = {
+    "primary": PartitionModelState(priority=0, constraints=0),
+    "replica": PartitionModelState(priority=0, constraints=1),
+}
+
+
+def pm(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+def build_maps(n_parts, nodes, rng):
+    beg, end = {}, {}
+    for i in range(n_parts):
+        name = f"{i:02d}"
+        b = rng.sample(nodes, 2)
+        e = rng.sample(nodes, 2)
+        beg[name] = {"primary": [b[0]], "replica": [b[1]]}
+        end[name] = {"primary": [e[0]], "replica": [e[1]]}
+    return pm(beg), pm(end)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_pause_resume_stop_orderings(seed):
+    rng = random.Random(seed)
+    nodes = ["a", "b", "c", "d"]
+    beg, end = build_maps(8, nodes, rng)
+
+    async def go():
+        ops_log = []
+
+        async def assign(stop_ch, node, partitions, states, ops):
+            ops_log.append((node, tuple(partitions), tuple(ops)))
+            await asyncio.sleep(0)  # yield to interleave control actions
+
+        o = orchestrate_moves(
+            MODEL,
+            OrchestratorOptions(
+                max_concurrent_partition_moves_per_node=rng.choice([1, 2, 3])),
+            nodes, beg, end, assign)
+
+        stop_after = rng.randint(0, 40)
+        actions = 0
+        last = None
+        pauses = resumes = 0
+        async for progress in o.progress_ch():
+            # Counter monotonicity.
+            if last is not None:
+                assert progress.tot_mover_assign_partition_ok >= \
+                    last.tot_mover_assign_partition_ok
+                assert progress.tot_run_supply_moves_loop >= \
+                    last.tot_run_supply_moves_loop
+            last = progress
+            actions += 1
+            r = rng.random()
+            if r < 0.2:
+                o.pause_new_assignments()
+                pauses += 1
+            elif r < 0.5:
+                o.resume_new_assignments()
+                resumes += 1
+            if actions == stop_after:
+                o.resume_new_assignments()  # stop while paused would wedge
+                o.stop()
+        # Stream closed; orchestrator must be fully wound down.
+        assert last is not None
+        assert last.tot_pause_new_assignments >= last.tot_resume_new_assignments
+        return last
+
+    last = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert last.tot_progress_close <= 1
+
+
+def test_stop_storm_never_hangs():
+    async def go():
+        beg, end = build_maps(6, ["a", "b", "c"], random.Random(7))
+
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MODEL, OrchestratorOptions(), ["a", "b", "c"], beg, end, assign)
+        for _ in range(5):
+            o.stop()
+        async for _ in o.progress_ch():
+            o.stop()
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+def test_ops_follow_per_partition_move_plans():
+    rng = random.Random(42)
+    nodes = ["a", "b", "c", "d"]
+    beg, end = build_maps(10, nodes, rng)
+
+    async def go():
+        executed: dict[str, list] = {}
+
+        def assign(stop_ch, node, partitions, states, ops):
+            for p, s, op in zip(partitions, states, ops):
+                executed.setdefault(p, []).append((node, s, op))
+
+        o = orchestrate_moves(
+            MODEL, OrchestratorOptions(max_concurrent_partition_moves_per_node=2),
+            nodes, beg, end, assign)
+        plans = {}
+        o.visit_next_moves(lambda m: plans.update(
+            {k: [(mv.node, mv.state, mv.op) for mv in v.moves]
+             for k, v in m.items()}))
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+        # Every partition executed exactly its planned sequence, in order.
+        for name, plan in plans.items():
+            assert executed.get(name, []) == plan, (name, executed.get(name), plan)
+    asyncio.run(asyncio.wait_for(go(), timeout=30))

@@ -1,0 +1,72 @@
+"""App-level rebalance facade + checkpoint round-trip tests."""
+
+import os
+
+from blance_tpu import (
+    Partition,
+    load_partition_map,
+    model,
+    plan_next_map,
+    plan_next_map_legacy,
+    rebalance,
+    save_partition_map,
+)
+
+M = model(primary=(0, 1), replica=(1, 1))
+
+
+def test_rebalance_end_to_end(tmp_path):
+    nodes = ["a", "b", "c", "d"]
+    beg, _ = plan_next_map(
+        {str(i): Partition(str(i), {}) for i in range(12)},
+        {str(i): Partition(str(i), {}) for i in range(12)},
+        nodes, [], nodes, M)
+
+    cluster = {p: {n: s for s, ns in part.nodes_by_state.items() for n in ns}
+               for p, part in beg.items()}
+
+    def assign(stop_ch, node, partitions, states, ops):
+        for p, s, _op in zip(partitions, states, ops):
+            if s == "":
+                cluster[p].pop(node, None)
+            else:
+                cluster[p][node] = s
+
+    ckpt = str(tmp_path / "target.json")
+    seen_progress = []
+    result = rebalance(
+        M, beg, nodes, ["d"], [], assign,
+        on_progress=seen_progress.append,
+        checkpoint_path=ckpt,
+    )
+
+    assert not result.warnings
+    assert result.progress_events == len(seen_progress) > 0
+    assert not result.progress.errors
+    assert "plan" in result.timer.totals and "orchestrate" in result.timer.totals
+
+    # The cluster converged to the planned map.
+    want = {p: {n: s for s, ns in part.nodes_by_state.items() for n in ns}
+            for p, part in result.next_map.items()}
+    assert cluster == want
+    # No assignments remain on the removed node.
+    assert all("d" not in v for v in cluster.values())
+
+    # Checkpoint written and loadable.
+    assert os.path.exists(ckpt)
+    assert load_partition_map(ckpt) == result.next_map
+
+
+def test_checkpoint_round_trip(tmp_path):
+    pmap = {"x": Partition("x", {"primary": ["a"], "replica": ["b", "c"]})}
+    path = str(tmp_path / "map.json")
+    save_partition_map(pmap, path)
+    assert load_partition_map(path) == pmap
+
+
+def test_legacy_signature():
+    result, warnings = plan_next_map_legacy(
+        {}, {"0": Partition("0", {})}, ["a", "b"], [], ["a", "b"], M,
+        None, None, None, {"a": 3}, None, None)
+    assert result["0"].nodes_by_state["primary"] == ["a"]
+    assert not warnings
