@@ -90,9 +90,10 @@ def run_config(name, P, N, model, rng, weights=False, racks=0,
         total_ops = sum(len(v) for v in moves.values())
         # Lower bound: copies on removed nodes must move (one op each) and
         # pair with an add.
+        removed = set(removes)
         displaced = sum(
             1 for p in prev.values() for ns in p.nodes_by_state.values()
-            for n in ns if n in set(removes))
+            for n in ns if n in removed)
         row["churn_ops"] = total_ops
         row["churn_lower_bound"] = 2 * displaced
     log(f"{name}: {row}")

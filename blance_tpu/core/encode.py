@@ -208,35 +208,79 @@ def decode_assignment(
 
     States absent from the model keep their (removed-node-stripped) previous
     assignment, matching the greedy planner's pass-through of unmodeled
-    states.
+    states.  Vectorized over P: the id->name gather, empty-slot packing and
+    shortfall detection run as whole-array numpy ops so decode stays off the
+    end-to-end critical path at 100k partitions (BASELINE.md).
     """
     assign = np.asarray(assign)
     next_map: PartitionMap = {}
     warnings: dict[str, list[str]] = {}
-    state_set = set(problem.states)
+    P = problem.P
 
-    for pi, pname in enumerate(problem.partitions):
-        nbs: dict[str, list[str]] = {}
-        # Pass through unmodeled states from the source partition.
+    # Per modeled state with constraints > 0: pack non-empty slots left
+    # (stable, preserving slot order), gather names in one shot, and convert
+    # to nested Python lists at C speed.
+    names_arr = np.asarray(problem.nodes, dtype=object) \
+        if problem.nodes else np.zeros(0, dtype=object)
+    per_state_rows: dict[int, list[list[str]]] = {}
+    per_state_counts: dict[int, np.ndarray] = {}
+    for si, sname in enumerate(problem.states):
+        want = int(problem.constraints[si])
+        if want <= 0:
+            continue
+        if P == 0 or not problem.nodes:
+            # Degenerate: nothing assignable; every slot is a shortfall.
+            per_state_rows[si] = [[] for _ in range(P)]
+            per_state_counts[si] = np.zeros(P, dtype=np.int64)
+            continue
+        ids = assign[:, si, :]
+        mask = ids >= 0
+        counts = mask.sum(axis=1)
+        order = np.argsort(~mask, axis=1, kind="stable")
+        packed = np.take_along_axis(ids, order, axis=1)
+        names = names_arr[np.maximum(packed, 0)]
+        nested = names.tolist()
+        if counts.min() == ids.shape[1]:  # all slots filled: no trimming
+            per_state_rows[si] = nested
+        else:
+            per_state_rows[si] = [
+                row[:c] for row, c in zip(nested, counts.tolist())]
+        per_state_counts[si] = counts
+
+    # Partitions needing the slow path: source has unmodeled or
+    # zero-constraint states to pass through (rare in practice).
+    constraints = problem.constraints
+    modeled = [
+        (si, s) for si, s in enumerate(problem.states)
+        if int(constraints[si]) > 0
+    ]
+    solved_states = {s for _, s in modeled}
+    mod_names = [s for _, s in modeled]
+    rows_per_state = [per_state_rows[si] for si, _ in modeled]
+    removed = nodes_to_remove or []
+    for pname, *vals in zip(problem.partitions, *rows_per_state):
         src = partitions_to_assign.get(pname)
-        if src is not None:
+        # keys() <= set is a C-level check; the passthrough branch (source
+        # carries unmodeled / zero-constraint states) is rare in practice.
+        if src is None or src.nodes_by_state.keys() <= solved_states:
+            nbs = dict(zip(mod_names, vals))
+        else:
+            nbs = {}
             for s, ns in src.nodes_by_state.items():
-                if s not in state_set:
-                    nbs[s] = strings_remove(ns, nodes_to_remove or [])
-        for si, sname in enumerate(problem.states):
-            want = int(problem.constraints[si])
-            if want <= 0:
-                if src is not None and sname in src.nodes_by_state:
-                    nbs[sname] = strings_remove(
-                        src.nodes_by_state[sname], nodes_to_remove or [])
-                continue
-            ids = [int(x) for x in assign[pi, si] if x >= 0]
-            nbs[sname] = [problem.nodes[i] for i in ids]
-            if len(ids) < want:
-                warnings.setdefault(pname, []).append(
-                    "could not meet constraints: %d, stateName: %s,"
-                    " partitionName: %s" % (want, sname, pname)
-                )
+                if s not in solved_states:
+                    nbs[s] = strings_remove(ns, removed)
+            for s, v in zip(mod_names, vals):
+                nbs[s] = v
         next_map[pname] = Partition(pname, nbs)
+
+    for si, sname in modeled:
+        want = int(constraints[si])
+        short = np.nonzero(per_state_counts[si] < want)[0]
+        for pi in short:
+            pname = problem.partitions[pi]
+            warnings.setdefault(pname, []).append(
+                "could not meet constraints: %d, stateName: %s,"
+                " partitionName: %s" % (want, sname, pname)
+            )
 
     return next_map, warnings

@@ -86,8 +86,11 @@ async def rebalance_async(
 
     assign_partitions(stop_ch, node, partitions, states, ops) is the app's
     data plane (sync or async).  on_progress sees every progress snapshot.
-    checkpoint_path, if set, saves the target map before orchestration and
-    the achieved map after.
+    checkpoint_path, if set, saves the planned target map before
+    orchestration begins; on a mid-orchestration crash, resume by re-running
+    rebalance from the app's current map (the planner is idempotent at
+    fixpoint, so the redo converges) or diff current vs the checkpointed
+    target directly.
     """
     timer = PhaseTimer()
     with timer.phase("plan"):

@@ -4,8 +4,8 @@ The reference has no tracing (SURVEY.md §5); its observability surface is
 the orchestrator progress stream.  Here, in addition to that stream, the
 framework exposes:
 
-- ``PhaseTimer``: nested wall-clock phase timing with a queryable report —
-  used by the planning facade to attribute time to encode / solve / decode.
+- ``PhaseTimer``: wall-clock phase timing with a queryable report — used by
+  the planning facade to attribute time to encode / solve / decode.
 - ``device_profile``: context manager around jax.profiler.trace for real
   TPU traces (viewable in TensorBoard / Perfetto), no-op if profiling is
   unavailable.
@@ -27,16 +27,13 @@ class PhaseTimer:
 
     totals: dict[str, float] = field(default_factory=dict)
     counts: dict[str, int] = field(default_factory=dict)
-    _stack: list[tuple[str, float]] = field(default_factory=list)
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
-        self._stack.append((name, start))
         try:
             yield
         finally:
-            self._stack.pop()
             elapsed = time.perf_counter() - start
             self.totals[name] = self.totals.get(name, 0.0) + elapsed
             self.counts[name] = self.counts.get(name, 0) + 1
@@ -62,10 +59,19 @@ def device_profile(log_dir: Optional[str]) -> Iterator[None]:
     if not log_dir:
         yield
         return
+    # Guard only profiler startup — exceptions raised by the caller's body
+    # must propagate unchanged (a second yield after throw() would mask
+    # them with RuntimeError).
+    trace_cm = None
     try:
         import jax
 
-        with jax.profiler.trace(log_dir):
-            yield
+        trace_cm = jax.profiler.trace(log_dir)
+        trace_cm.__enter__()
     except Exception:
+        trace_cm = None
+    try:
         yield
+    finally:
+        if trace_cm is not None:
+            trace_cm.__exit__(None, None, None)

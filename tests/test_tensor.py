@@ -173,3 +173,21 @@ def test_check_assignment_clean():
     counts = check_assignment(problem, assign)
     assert counts == {"duplicates": 0, "on_removed_nodes": 0,
                       "unfilled_feasible_slots": 0}
+
+
+def test_degenerate_empty_partitions():
+    # P == 0 must not crash the vectorized decode (tensor.py routes it there).
+    result, warnings = plan_next_map(
+        {}, {}, ["a", "b"], [], [], M_1P_1R, backend="tpu")
+    assert result == {} and warnings == {}
+
+
+def test_degenerate_zero_nodes():
+    # N == 0 with P > 0: empty assignments plus a shortfall warning per state.
+    parts = empty_parts(3)
+    result, warnings = plan_next_map(
+        empty_parts(3), parts, [], [], [], M_1P_1R, backend="tpu")
+    for p in result.values():
+        assert p.nodes_by_state == {"primary": [], "replica": []}
+    assert all(len(w) == 2 for w in warnings.values())
+    assert len(warnings) == 3
