@@ -87,14 +87,12 @@ def encode_problem(
     # Deferred to avoid a core <-> plan import cycle at package init; the
     # greedy key function is the single source of truth so dense ids match
     # the greedy planner's deterministic iteration order exactly.
-    from ..plan.greedy import _partition_name_key, sort_state_names
+    from ..plan.greedy import sort_state_names, sorted_by_partition_name
 
     nodes = list(nodes_all)
     node_index = {n: i for i, n in enumerate(nodes)}
 
-    partitions = sorted(
-        partitions_to_assign.keys(), key=lambda n: (_partition_name_key(n), n)
-    )
+    partitions = sorted_by_partition_name(partitions_to_assign.keys())
     states = sort_state_names(model)
     state_index = {s: i for i, s in enumerate(states)}
 
@@ -258,8 +256,11 @@ def decode_assignment(
     mod_names = [s for _, s in modeled]
     rows_per_state = [per_state_rows[si] for si, _ in modeled]
     removed = nodes_to_remove or []
-    for pname, *vals in zip(problem.partitions, *rows_per_state):
-        src = partitions_to_assign.get(pname)
+    rows_iter = zip(*rows_per_state) if rows_per_state \
+        else (() for _ in range(P))
+    get_src = partitions_to_assign.get
+    for pname, vals in zip(problem.partitions, rows_iter):
+        src = get_src(pname)
         # keys() <= set is a C-level check; the passthrough branch (source
         # carries unmodeled / zero-constraint states) is rare in practice.
         if src is None or src.nodes_by_state.keys() <= solved_states:

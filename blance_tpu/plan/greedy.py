@@ -28,6 +28,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+import numpy as np
+
 from ..core.hierarchy import (
     include_exclude_nodes_intersect,
     parents_to_children,
@@ -196,6 +198,40 @@ def _partition_name_key(name: str) -> str:
     if n < 0 or n >= 2**63:
         return name
     return f"{n:>10d}"
+
+
+def sorted_by_partition_name(names) -> list[str]:
+    """Sort names by (zero-padded-numeric-else-raw key, name) — the static
+    component of the reference's partition order (plan.go:524-528).
+
+    Vectorized for large inputs: plain ASCII-digit names (the overwhelmingly
+    common shape) get their sort key built with numpy byte-string ops and
+    ordered via lexsort; signed or >18-digit numerics fall back to
+    `_partition_name_key` per element, and any non-ASCII input drops the
+    whole batch back to the pure-Python path.  Byte-wise bytes comparison
+    equals Go's string comparison for ASCII, so the order is identical."""
+    names = list(names)
+    if len(names) < 4096:
+        return sorted(names, key=lambda n: (_partition_name_key(n), n))
+    try:
+        arr = np.asarray(names, dtype="S")
+    except UnicodeEncodeError:
+        return sorted(names, key=lambda n: (_partition_name_key(n), n))
+    lens = np.char.str_len(arr)
+    digit = np.char.isdigit(arr) & (lens <= 18)
+    width = max(int(arr.dtype.itemsize), 10)
+    keys = arr.astype(f"S{width}")
+    if digit.any():
+        d = arr[digit]
+        stripped = np.char.lstrip(d, b"0")
+        stripped = np.where(stripped == b"", b"0", stripped)
+        keys[digit] = np.char.rjust(stripped, 10)
+    odd = np.char.startswith(arr, b"+") | np.char.startswith(arr, b"-") \
+        | (np.char.isdigit(arr) & (lens > 18))
+    for i in np.nonzero(odd)[0]:
+        keys[i] = _partition_name_key(names[i]).encode()
+    order = np.lexsort((arr, keys))
+    return [names[i] for i in order]
 
 
 def _partition_weight_key(weight: int) -> str:

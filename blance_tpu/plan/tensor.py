@@ -46,6 +46,7 @@ from jax import lax
 
 from ..core.encode import DenseProblem, decode_assignment, encode_problem
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from ..ops.reduce2 import min2_argmin, min2_argmin_reference, pallas_available
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "check_assignment"]
 
@@ -151,11 +152,13 @@ def _assign_slot(
         # if the counts term had updated, so bids keep spreading even
         # within one slot wave.
         eff = score + (used * price_scale)[None, :] + open_pen
-        best = jnp.min(eff, axis=1)
-        choice = jnp.argmin(eff, axis=1).astype(jnp.int32)
-        # Second-best for the urgency margin.
-        masked = eff.at[jnp.arange(p), choice].set(jnp.inf)
-        second = jnp.min(masked, axis=1)
+        # Fused (min, argmin, second-min) — a single HBM pass via the Pallas
+        # kernel on TPU (blance_tpu/ops/reduce2.py); the XLA spelling
+        # (3 reductions + a full [P, N] position-mask copy) elsewhere.
+        if pallas_available():
+            best, choice, second = min2_argmin(eff)
+        else:
+            best, choice, second = min2_argmin_reference(eff)
         margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
 
         active = unassigned & (best < _INF / 2)
@@ -253,9 +256,14 @@ def _assign_slot(
         # Freshly-created carries are axis-invariant until the (shard-local)
         # loop body makes them varying; mark them varying up front so carry
         # types agree.  Skip values that are already varying.
+        _to_varying = (
+            (lambda x: lax.pcast(x, (axis_name,), to="varying"))
+            if hasattr(lax, "pcast")
+            else (lambda x: lax.pvary(x, (axis_name,))))
+
         def ensure_varying(x):
             vma = getattr(jax.typeof(x), "vma", frozenset())
-            return x if axis_name in vma else lax.pvary(x, (axis_name,))
+            return x if axis_name in vma else _to_varying(x)
         init = tuple(ensure_varying(x) for x in init)
     slot_assign, unassigned, _rem, used, _, _ = lax.while_loop(
         round_cond, round_body, init)
