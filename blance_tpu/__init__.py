@@ -34,6 +34,7 @@ from .core.setops import (
 )
 from .moves.calc import NodeStateOp, calc_partition_moves
 from .plan.api import plan_next_map, plan_next_map_legacy
+from .plan.session import PlannerSession
 from .rebalance import (
     RebalanceResult,
     load_partition_map,
@@ -60,6 +61,7 @@ __all__ = [
     "PartitionModel",
     "PartitionModelState",
     "PlanOptions",
+    "PlannerSession",
     "NodeScoreContext",
     "NodeStateOp",
     "calc_partition_moves",

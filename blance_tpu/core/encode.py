@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from . import marshal as _marshal
 from .hierarchy import find_ancestor, level_group_ids
 from .setops import strings_remove
 from .types import (
@@ -104,26 +105,51 @@ def encode_problem(
         constraints[state_index[s]] = c
 
     # Slot depth: enough for the widest constraint and the widest prev row.
+    # The R scan and the [P, S, R] fill each touch every cell once; at 100k
+    # partitions that dict/list traversal dominates end-to-end wall-clock,
+    # so both run in the native marshalling layer when it's available
+    # (native/marshal.c), with this pure-Python path as the fallback.
+    # The C fast path is stricter about shapes (real dicts, real lists);
+    # any structural surprise raises TypeError there and we fall back to
+    # this loop, which tolerates arbitrary Mappings/Sequences.
+    native = _marshal.get()
     r_max = int(constraints.max()) if len(constraints) else 0
-    for pname in partitions:
-        src = prev_map.get(pname) or partitions_to_assign[pname]
-        for s, ns in src.nodes_by_state.items():
-            if s in state_index:
-                r_max = max(r_max, len(ns))
-    r_max = max(r_max, 1)
+    filled = None
+    if native is not None:
+        try:
+            r_max = max(r_max, native.max_slots(
+                partitions, prev_map, partitions_to_assign, state_index))
+            r_max = max(r_max, 1)
+            P, S = len(partitions), len(states)
+            filled = np.empty((P, S, r_max), dtype=np.int32)
+            native.fill_prev(filled, P, S, r_max, partitions, prev_map,
+                             partitions_to_assign, state_index, node_index)
+        except TypeError:
+            filled = None
+            r_max = int(constraints.max()) if len(constraints) else 0
+    if filled is None:
+        for pname in partitions:
+            src = prev_map.get(pname) or partitions_to_assign[pname]
+            for s, ns in src.nodes_by_state.items():
+                if s in state_index:
+                    r_max = max(r_max, len(ns))
+        r_max = max(r_max, 1)
 
     P, S, N = len(partitions), len(states), len(nodes)
-    prev = np.full((P, S, r_max), -1, dtype=np.int32)
-    for pi, pname in enumerate(partitions):
-        src = prev_map.get(pname) or partitions_to_assign.get(pname)
-        if src is None:
-            continue
-        for s, ns in src.nodes_by_state.items():
-            si = state_index.get(s)
-            if si is None:
+    if filled is not None:
+        prev = filled
+    else:
+        prev = np.full((P, S, r_max), -1, dtype=np.int32)
+        for pi, pname in enumerate(partitions):
+            src = prev_map.get(pname) or partitions_to_assign.get(pname)
+            if src is None:
                 continue
-            for ri, node in enumerate(ns[:r_max]):
-                prev[pi, si, ri] = node_index.get(node, -1)
+            for s, ns in src.nodes_by_state.items():
+                si = state_index.get(s)
+                if si is None:
+                    continue
+                for ri, node in enumerate(ns[:r_max]):
+                    prev[pi, si, ri] = node_index.get(node, -1)
 
     pweights = np.ones(P, dtype=np.float32)
     if opts.partition_weights:
@@ -150,13 +176,14 @@ def encode_problem(
     pw = opts.partition_weights
     ss = opts.state_stickiness
     ss_active = ss is not None and (pw is not None or opts.state_stickiness_standalone)
-    for pi, pname in enumerate(partitions):
-        if pw is not None and pname in pw:
-            stickiness[pi, :] = pw[pname]
-        elif ss_active:
-            for si, s in enumerate(states):
-                if s in ss:
-                    stickiness[pi, si] = ss[s]
+    if pw or ss_active:
+        for pi, pname in enumerate(partitions):
+            if pw is not None and pname in pw:
+                stickiness[pi, :] = pw[pname]
+            elif ss_active:
+                for si, s in enumerate(states):
+                    if s in ss:
+                        stickiness[pi, si] = ss[s]
 
     # Hierarchy group ids.  Levels needed = max level referenced by any rule.
     rules_by_state: dict[int, list[tuple[int, int]]] = {}
@@ -211,7 +238,6 @@ def decode_assignment(
     end-to-end critical path at 100k partitions (BASELINE.md).
     """
     assign = np.asarray(assign)
-    next_map: PartitionMap = {}
     warnings: dict[str, list[str]] = {}
     P = problem.P
 
@@ -256,23 +282,35 @@ def decode_assignment(
     mod_names = [s for _, s in modeled]
     rows_per_state = [per_state_rows[si] for si, _ in modeled]
     removed = nodes_to_remove or []
-    rows_iter = zip(*rows_per_state) if rows_per_state \
-        else (() for _ in range(P))
-    get_src = partitions_to_assign.get
-    for pname, vals in zip(problem.partitions, rows_iter):
-        src = get_src(pname)
-        # keys() <= set is a C-level check; the passthrough branch (source
-        # carries unmodeled / zero-constraint states) is rare in practice.
-        if src is None or src.nodes_by_state.keys() <= solved_states:
-            nbs = dict(zip(mod_names, vals))
-        else:
-            nbs = {}
-            for s, ns in src.nodes_by_state.items():
-                if s not in solved_states:
-                    nbs[s] = strings_remove(ns, removed)
-            for s, v in zip(mod_names, vals):
-                nbs[s] = v
-        next_map[pname] = Partition(pname, nbs)
+    native = _marshal.get()
+    next_map = None
+    if native is not None:
+        try:
+            next_map = native.build_map(
+                Partition, problem.partitions, mod_names, rows_per_state,
+                partitions_to_assign, solved_states, set(removed))
+        except TypeError:
+            next_map = None  # structural surprise: pure-Python fallback
+    if next_map is None:
+        next_map = {}
+        rows_iter = zip(*rows_per_state) if rows_per_state \
+            else (() for _ in range(P))
+        get_src = partitions_to_assign.get
+        for pname, vals in zip(problem.partitions, rows_iter):
+            src = get_src(pname)
+            # keys() <= set is a C-level check; the passthrough branch
+            # (source carries unmodeled / zero-constraint states) is rare
+            # in practice.
+            if src is None or src.nodes_by_state.keys() <= solved_states:
+                nbs = dict(zip(mod_names, vals))
+            else:
+                nbs = {}
+                for s, ns in src.nodes_by_state.items():
+                    if s not in solved_states:
+                        nbs[s] = strings_remove(ns, removed)
+                for s, v in zip(mod_names, vals):
+                    nbs[s] = v
+            next_map[pname] = Partition(pname, nbs)
 
     for si, sname in modeled:
         want = int(constraints[si])

@@ -59,11 +59,25 @@ ErrorInterrupt = InterruptError("interrupt")
 
 @dataclass
 class OrchestratorOptions:
-    """Advanced config (orchestrate.go:110-115)."""
+    """Advanced config (orchestrate.go:110-115 + scale extensions)."""
 
     # <= 0 is treated as 1 (orchestrate.go:484-487).
     max_concurrent_partition_moves_per_node: int = 1
     favor_min_nodes: bool = False
+
+    # -- scale extensions (not in the reference) --
+    # True (reference semantics, orchestrate.go:566-580): the first
+    # successful feed each round interrupts all other feeders, so
+    # availability is recomputed after every accepted batch — freshest
+    # choices, but rounds commit ~one batch each.  False: every node's
+    # feeder completes its feed before the next round, so a round commits
+    # up to len(nodes) batches — the throughput mode for 10k+ partition
+    # rebalances, where per-batch recomputes would be quadratic.
+    interrupt_on_first_feed: bool = True
+    # Compute the up-front per-partition move plans with the batched
+    # on-device diff (moves/batch.py) instead of the per-partition host
+    # loop.  Identical op lists; worthwhile from ~10k partitions up.
+    device_diff: bool = False
 
 
 @dataclass
@@ -369,7 +383,24 @@ class Orchestrator:
             broadcast_stop_ch = Chan()
             broadcast_done_ch = Chan()
 
-            for node, next_moves_arr in available.items():
+            interrupt = self.options.interrupt_on_first_feed
+
+            # A move can target a node with no mover (not in nodes_all); its
+            # feeder blocks until stop/broadcast (reference orchestrate.go:667
+            # nil-channel semantics).  In interrupt mode the first success
+            # unblocks it every round.  In throughput mode broadcast closes
+            # only after all feeders report, so a blocked feeder would
+            # deadlock the round — skip moverless nodes instead, unless NO
+            # node is feedable (then spawn the blocking feeders to reproduce
+            # the reference's wedge-until-Stop rather than a busy spin).
+            feed_nodes = available
+            if not interrupt:
+                feedable = {node: arr for node, arr in available.items()
+                            if node in self._map_node_to_req_ch}
+                if feedable:
+                    feed_nodes = feedable
+
+            for node, next_moves_arr in feed_nodes.items():
                 picked = self._filter_next_plausible_moves_for_node(
                     node, next_moves_arr)
                 self._tasks.append(asyncio.ensure_future(self._run_supply_move(
@@ -380,11 +411,13 @@ class Orchestrator:
                                 self._progress.tot_run_supply_moves_feeding + 1))
 
             # First successful feed interrupts the other feeders so the next
-            # round recomputes availability (orchestrate.go:566-580).
+            # round recomputes availability (orchestrate.go:566-580); in
+            # throughput mode every feeder finishes and a round commits up
+            # to len(feed_nodes) batches.
             broadcast_stopped = False
-            for _ in range(len(available)):
+            for _ in range(len(feed_nodes)):
                 err, _ok = await broadcast_done_ch.get()
-                if err is None and not broadcast_stopped:
+                if err is None and interrupt and not broadcast_stopped:
                     broadcast_stop_ch.close()
                     broadcast_stopped = True
                 if err is not None and err is not ErrorInterrupt and err_outer is None:
@@ -535,18 +568,27 @@ def orchestrate_moves(
     states = sort_state_names(model)
 
     # Per-partition flight plans, computed up front without regard to other
-    # partitions (orchestrate.go:264-287).
+    # partitions (orchestrate.go:264-287) — on device when asked.
     map_partition_to_next_moves: dict[str, NextMoves] = {}
-    for partition_name, beg_partition in beg_map.items():
-        end_partition = end_map[partition_name]
-        moves = calc_partition_moves(
-            states,
-            beg_partition.nodes_by_state,
-            end_partition.nodes_by_state,
-            options.favor_min_nodes,
-        )
-        map_partition_to_next_moves[partition_name] = NextMoves(
-            partition_name, moves)
+    if options.device_diff:
+        from ..moves.batch import calc_all_moves
+
+        all_moves = calc_all_moves(
+            beg_map, end_map, model, options.favor_min_nodes)
+        for partition_name in beg_map:
+            map_partition_to_next_moves[partition_name] = NextMoves(
+                partition_name, all_moves[partition_name])
+    else:
+        for partition_name, beg_partition in beg_map.items():
+            end_partition = end_map[partition_name]
+            moves = calc_partition_moves(
+                states,
+                beg_partition.nodes_by_state,
+                end_partition.nodes_by_state,
+                options.favor_min_nodes,
+            )
+            map_partition_to_next_moves[partition_name] = NextMoves(
+                partition_name, moves)
 
     o = Orchestrator(
         model, options, nodes_all, beg_map, end_map,

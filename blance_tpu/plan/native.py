@@ -21,12 +21,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 from typing import Optional
 
 import numpy as np
 
 from ..core.hierarchy import find_ancestor, parents_to_children
+from ..utils.nativebuild import compile_cached
 from ..core.setops import strings_intersect, strings_remove
 from ..core.types import Partition, PartitionMap, PartitionModel, PlanOptions
 from .greedy import (
@@ -35,6 +35,7 @@ from .greedy import (
     count_state_nodes,
     plan_next_map_greedy,
     sort_state_names,
+    sorted_by_partition_name,
 )
 
 __all__ = ["plan_next_map_native", "cbgt_node_score_booster", "native_available"]
@@ -70,21 +71,14 @@ def _load_lib() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _LIB_FAILED:
         return _LIB
     src = _source_path()
-    if not os.path.exists(src):
+    so = os.path.join(_build_dir(), "_native_planner.so")
+    if not compile_cached(src, so, ["g++", "-O3", "-shared", "-fPIC",
+                                    "-std=c++17", "-o", so, src]):
         _LIB_FAILED = True
         return None
-    out_dir = _build_dir()
-    os.makedirs(out_dir, exist_ok=True)
-    so = os.path.join(out_dir, "_native_planner.so")
     try:
-        if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-o", so, src],
-                check=True, capture_output=True)
         lib = ctypes.CDLL(so)
-    except (OSError, subprocess.CalledProcessError):
+    except OSError:
         _LIB_FAILED = True
         return None
 
@@ -155,8 +149,7 @@ def _plan_inner_native(
     n_candidates = len(nodes_all)
     states = sort_state_names(model)
     state_index = {s: i for i, s in enumerate(states)}
-    partitions = sorted(
-        partitions_to_assign.keys(), key=lambda n: (_partition_name_key(n), n))
+    partitions = sorted_by_partition_name(partitions_to_assign.keys())
     P, S, N = len(partitions), len(states), len(nodes)
 
     constraints = np.zeros(max(S, 1), np.int32)

@@ -1,0 +1,248 @@
+"""Long-lived dense planning sessions.
+
+``plan_next_map`` (plan/api.py) is a pure function of PartitionMaps, like
+the reference's PlanNextMapEx (reference api.go:147-157) — every call pays
+the string<->id marshalling toll at the edges.  At 100k partitions that
+toll dominates wall-clock (BASELINE.md), and a real cluster rebalances the
+*same* index repeatedly: same partitions, same states, a slowly-changing
+node set.
+
+``PlannerSession`` amortizes everything that doesn't change: interning
+tables, model/rule encoding, hierarchy group ids, the compiled solver, and
+the current dense assignment.  The steady-state loop is
+
+    session.remove_nodes(["n7"])       # cluster delta, O(delta)
+    proposed = session.replan()        # on-device solve, no marshalling
+    nodes, states, ops = session.moves()   # on-device diff vs current
+    session.apply()                    # adopt the proposed assignment
+
+with PartitionMaps materializing only at the edges (``load_map`` /
+``to_map``) for checkpoints and app hand-off.  An optional mesh runs the
+solve sharded over the partition axis (parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.encode import decode_assignment, encode_problem
+from ..core.types import (
+    Partition,
+    PartitionMap,
+    PartitionModel,
+    PlanOptions,
+)
+
+__all__ = ["PlannerSession"]
+
+
+class PlannerSession:
+    """Stateful dense planner for one logical index.
+
+    Parameters
+    ----------
+    model: state name -> PartitionModelState (priorities + constraints).
+    nodes: every node that may ever appear, in tie-break order (node order
+        is the planner's deterministic tie-break, reference plan.go:617-628).
+    partitions: partition names; placement order is the planner's canonical
+        name sort.
+    opts: planner knobs; weights/stickiness/hierarchy are encoded once.
+    mesh: optional jax.sharding.Mesh — shards the solve over partitions.
+    """
+
+    def __init__(
+        self,
+        model: PartitionModel,
+        nodes: list[str],
+        partitions: list[str],
+        opts: Optional[PlanOptions] = None,
+        mesh=None,
+    ) -> None:
+        self.model = model
+        self.opts = opts or PlanOptions()
+        self.mesh = mesh
+        self._removed: set[str] = set()
+        self._nodes = list(nodes)
+        self._partition_names = list(partitions)
+        self._reencode(prev_map={})
+        # current/proposed dense assignments [P, S, R] int32, -1 = empty.
+        self.current = self._problem.prev.copy()
+        self.proposed: Optional[np.ndarray] = None
+
+    # -- encoding ------------------------------------------------------------
+
+    def _reencode(self, prev_map: PartitionMap) -> None:
+        """(Re)build the dense problem statics; prev_map seeds ``prev``."""
+        pta = {name: Partition(name, {}) for name in self._partition_names}
+        self._problem = encode_problem(
+            prev_map, pta, self._nodes, sorted(self._removed),
+            self.model, self.opts)
+        self._node_index = {n: i for i, n in enumerate(self._problem.nodes)}
+
+    @property
+    def problem(self):
+        """The encoded statics (DenseProblem); prev reflects ``current``."""
+        return self._problem
+
+    # -- cluster membership ----------------------------------------------------
+
+    def add_nodes(self, names: list[str]) -> None:
+        """Add nodes (new capacity attracts load on the next replan)."""
+        grew = False
+        for n in names:
+            self._removed.discard(n)
+            if n not in self._node_index:
+                self._nodes.append(n)
+                self._node_index[n] = len(self._nodes) - 1
+                grew = True
+        if grew:
+            current = self.current
+            self._reencode(prev_map={})
+            # Node ids are append-only, so the old assignment is still valid.
+            r_new = self._problem.R
+            if r_new > current.shape[2]:
+                pad = np.full(
+                    current.shape[:2] + (r_new - current.shape[2],),
+                    -1, np.int32)
+                current = np.concatenate([current, pad], axis=2)
+            self.current = current
+        else:
+            self._problem.valid_node[:] = [
+                n not in self._removed for n in self._problem.nodes]
+
+    def remove_nodes(self, names: list[str]) -> None:
+        """Mark nodes for removal: the next replan drains them."""
+        self._removed.update(names)
+        self._problem.valid_node[:] = [
+            n not in self._removed for n in self._problem.nodes]
+
+    @property
+    def nodes(self) -> list[str]:
+        return list(self._problem.nodes)
+
+    @property
+    def removed_nodes(self) -> list[str]:
+        return sorted(self._removed)
+
+    # -- map edges ---------------------------------------------------------------
+
+    def load_map(self, prev_map: PartitionMap) -> None:
+        """Adopt an existing PartitionMap as the current assignment.
+
+        Raises on placements the session cannot represent (nodes outside
+        the session's node list) — silently treating a live placement as
+        vacant would let the next replan double-book it.  Unmodeled states
+        are dropped (the session covers modeled states only; keep the
+        PartitionMap if you need unmodeled-state passthrough).
+        """
+        unknown_parts = set(prev_map) - set(self._partition_names)
+        if unknown_parts:
+            raise ValueError(
+                "load_map: partitions outside this session: "
+                f"{sorted(unknown_parts)[:8]}")
+        self._reencode(prev_map=prev_map)
+        modeled = set(self._problem.states)
+        known = self._node_index
+        expected = 0
+        for partition in prev_map.values():
+            for sname, ns in partition.nodes_by_state.items():
+                if sname in modeled:
+                    expected += len(ns)
+        got = int((self._problem.prev >= 0).sum())
+        if got != expected:
+            unknown = sorted({
+                node
+                for partition in prev_map.values()
+                for sname, ns in partition.nodes_by_state.items()
+                if sname in modeled
+                for node in ns if node not in known})
+            raise ValueError(
+                f"load_map: {expected - got} placements not representable; "
+                f"unknown nodes: {unknown[:8]}")
+        self.current = self._problem.prev.copy()
+        self.proposed = None
+
+    def to_map(
+        self, which: str = "current"
+    ) -> tuple[PartitionMap, dict[str, list[str]]]:
+        """Materialize ``current`` or ``proposed`` as (PartitionMap,
+        warnings); the session's checkpoint format, like the reference's
+        JSON-taggable maps (api.go:30-35)."""
+        assign = self.proposed if which == "proposed" else self.current
+        if assign is None:
+            raise ValueError("no proposed assignment; call replan() first")
+        pta = {name: Partition(name, {}) for name in self._partition_names}
+        return decode_assignment(
+            self._problem, assign, pta, sorted(self._removed))
+
+    # -- the loop -------------------------------------------------------------
+
+    def replan(self) -> np.ndarray:
+        """Solve placement from ``current`` on device; stores and returns
+        the proposed assignment (does not adopt it — see apply())."""
+        import jax.numpy as jnp
+
+        from .tensor import solve_dense
+
+        prob = self._problem
+        rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
+        constraints = tuple(int(c) for c in prob.constraints)
+        if prob.P == 0 or prob.N == 0 or prob.S == 0:
+            self.proposed = self.current.copy()
+            return self.proposed
+
+        if self.mesh is not None:
+            from ..parallel.sharded import solve_dense_sharded
+
+            assign = solve_dense_sharded(
+                self.mesh, self.current, prob.partition_weights,
+                prob.node_weights, prob.valid_node, prob.stickiness,
+                prob.gids, prob.gid_valid, constraints, rules)
+        else:
+            assign = np.asarray(solve_dense(
+                jnp.asarray(self.current),
+                jnp.asarray(prob.partition_weights),
+                jnp.asarray(prob.node_weights),
+                jnp.asarray(prob.valid_node),
+                jnp.asarray(prob.stickiness),
+                jnp.asarray(prob.gids),
+                jnp.asarray(prob.gid_valid),
+                constraints, rules))
+        self.proposed = assign
+        return assign
+
+    def moves(
+        self, favor_min_nodes: bool = False
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """On-device diff current -> proposed: (nodes, states, ops) as
+        [P, L] arrays with -1 padding (see moves/batch.py for codes).
+        Row i is partition ``self.problem.partitions[i]``."""
+        import jax.numpy as jnp
+
+        from ..moves.batch import diff_assignments
+
+        if self.proposed is None:
+            raise ValueError("no proposed assignment; call replan() first")
+        r = max(self.current.shape[2], self.proposed.shape[2])
+
+        def widen(a):
+            if a.shape[2] == r:
+                return a
+            pad = np.full(a.shape[:2] + (r - a.shape[2],), -1, np.int32)
+            return np.concatenate([a, pad], axis=2)
+
+        d_nodes, d_states, d_ops = diff_assignments(
+            jnp.asarray(widen(self.current)),
+            jnp.asarray(widen(self.proposed)),
+            self._problem.N, favor_min_nodes)
+        return np.asarray(d_nodes), np.asarray(d_states), np.asarray(d_ops)
+
+    def apply(self) -> None:
+        """Adopt the proposed assignment as current (the app moved the
+        data); removed nodes no longer hold anything after this."""
+        if self.proposed is None:
+            raise ValueError("no proposed assignment; call replan() first")
+        self.current = self.proposed
+        self.proposed = None

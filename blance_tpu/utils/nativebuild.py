@@ -1,0 +1,34 @@
+"""Shared compile-and-cache helper for the repo's native components.
+
+Both native loaders — the ctypes planner core (plan/native.py) and the
+CPython marshalling extension (core/marshal.py) — need the same shape:
+compile the source once, cache the .so next to the package, rebuild when
+the source is newer, and never hard-fail when the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+__all__ = ["compile_cached"]
+
+
+def compile_cached(source: str, out_path: str, command: list[str]) -> bool:
+    """Ensure ``out_path`` exists and is newer than ``source``.
+
+    ``command`` is the full compiler invocation (it should reference
+    ``source`` and ``out_path``).  Returns True when a fresh-enough binary
+    is in place; False when the source is missing or the build failed —
+    callers fall back to their pure-Python paths.
+    """
+    if not os.path.exists(source):
+        return False
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    try:
+        if (not os.path.exists(out_path)
+                or os.path.getmtime(out_path) < os.path.getmtime(source)):
+            subprocess.run(command, check=True, capture_output=True)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False

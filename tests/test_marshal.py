@@ -1,0 +1,110 @@
+"""Native marshalling layer (native/marshal.c): parity vs the pure-Python
+encode/decode paths on randomized problems, including the awkward cases —
+unmodeled passthrough states, unknown node names, removed nodes, empty
+partitions."""
+
+import numpy as np
+import pytest
+
+import blance_tpu.core.encode as enc
+import blance_tpu.core.marshal as marshal
+from blance_tpu.core.types import Partition, PartitionModelState, PlanOptions
+
+pytestmark = pytest.mark.skipif(
+    not marshal.available(), reason="native marshal unavailable")
+
+
+def _random_problem(seed, P=200, N=16):
+    rng = np.random.default_rng(seed)
+    nodes = [f"n{i}" for i in range(N)]
+    model = {
+        "primary": PartitionModelState(0, 2),
+        "replica": PartitionModelState(1, 1),
+    }
+    prev = {}
+    for i in range(P):
+        name = str(i)
+        nbs = {}
+        if rng.random() < 0.9:
+            k = int(rng.integers(1, 4))
+            nbs["primary"] = [nodes[j] for j in rng.choice(N, k, replace=False)]
+        if rng.random() < 0.7:
+            nbs["replica"] = [nodes[int(rng.integers(0, N))]]
+        if rng.random() < 0.1:
+            nbs["unmodeled"] = [nodes[0], "ghost-node", nodes[1]]
+        if rng.random() < 0.05:
+            nbs["primary"] = ["ghost-node"]  # unknown name -> -1 / skipped
+        prev[name] = Partition(name, nbs)
+    return prev, nodes, model
+
+
+def _with_native(flag):
+    """Flip the loader so the same call takes the native or Python path."""
+    marshal._MOD = None
+    marshal._FAILED = not flag
+    if flag:
+        assert marshal.available()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_encode_parity(seed):
+    prev, nodes, model = _random_problem(seed)
+    opts = PlanOptions()
+    removed = [nodes[1]]
+    try:
+        _with_native(True)
+        a = enc.encode_problem(prev, prev, nodes, removed, model, opts)
+        _with_native(False)
+        b = enc.encode_problem(prev, prev, nodes, removed, model, opts)
+    finally:
+        _with_native(True)
+    assert a.partitions == b.partitions
+    assert a.prev.shape == b.prev.shape
+    assert (a.prev == b.prev).all()
+    assert (a.valid_node == b.valid_node).all()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_decode_parity(seed):
+    prev, nodes, model = _random_problem(seed)
+    opts = PlanOptions()
+    removed = [nodes[2]]
+    problem = enc.encode_problem(prev, prev, nodes, removed, model, opts)
+    # Decode the previous assignment itself (plus some -1 holes).
+    assign = problem.prev.copy()
+    assign[::7, 0, -1] = -1
+    try:
+        _with_native(True)
+        map_n, warn_n = enc.decode_assignment(problem, assign, prev, removed)
+        _with_native(False)
+        map_p, warn_p = enc.decode_assignment(problem, assign, prev, removed)
+    finally:
+        _with_native(True)
+    assert warn_n == warn_p
+    assert set(map_n) == set(map_p)
+    for k in map_p:
+        assert map_n[k].name == map_p[k].name
+        assert map_n[k].nodes_by_state == map_p[k].nodes_by_state
+
+
+def test_empty_problem():
+    _with_native(True)
+    model = {"primary": PartitionModelState(0, 1)}
+    problem = enc.encode_problem({}, {}, [], None, model, PlanOptions())
+    assert problem.P == 0
+    m, w = enc.decode_assignment(
+        problem, np.full((0, 1, 1), -1, np.int32), {}, None)
+    assert m == {} and w == {}
+
+
+def test_structural_surprise_falls_back():
+    """Tuple node lists / odd containers take the pure-Python path instead
+    of crashing (marshal.c is stricter than the fallback by design)."""
+    _with_native(True)
+    model = {"primary": PartitionModelState(0, 1)}
+    prev = {"p": Partition("p", {"primary": ("n0", "n1")})}  # tuple, not list
+    problem = enc.encode_problem(prev, prev, ["n0", "n1"], None, model,
+                                 PlanOptions())
+    assert problem.prev[0, 0, 0] == 0 and problem.prev[0, 0, 1] == 1
+    m, w = enc.decode_assignment(problem, problem.prev, prev, None)
+    assert m["p"].nodes_by_state["primary"] == ["n0", "n1"]

@@ -1,0 +1,139 @@
+"""PlannerSession: the stateful dense planning loop (plan/session.py).
+
+Covers the steady-state loop (replan / moves / apply), map edges
+(load_map / to_map), cluster deltas (add/remove nodes), and agreement with
+the one-shot plan_next_map TPU backend on identical inputs."""
+
+import numpy as np
+import pytest
+
+from blance_tpu import Partition, PlanOptions, model, plan_next_map
+from blance_tpu.moves.batch import OP_NAMES
+from blance_tpu.plan.session import PlannerSession
+from blance_tpu.plan.tensor import check_assignment
+
+
+MODEL = model(primary=(0, 1), replica=(1, 1))
+NODES = [f"n{i}" for i in range(8)]
+PARTS = [str(i) for i in range(64)]
+
+
+def fresh_session():
+    s = PlannerSession(MODEL, NODES, PARTS)
+    s.replan()
+    s.apply()
+    return s
+
+
+def test_fresh_plan_satisfies_constraints():
+    s = fresh_session()
+    assert s.current.shape[0] == len(PARTS)
+    report = check_assignment(s.problem, s.current)
+    assert report == {"duplicates": 0, "on_removed_nodes": 0,
+                      "unfilled_feasible_slots": 0}
+    # Balanced: every node holds roughly P*2/8 copies.
+    counts = np.bincount(s.current[s.current >= 0], minlength=len(NODES))
+    assert counts.max() - counts.min() <= 2
+
+
+def test_map_round_trip():
+    s = fresh_session()
+    m, warnings = s.to_map()
+    assert warnings == {}
+    assert set(m) == set(PARTS)
+    s2 = PlannerSession(MODEL, NODES, PARTS)
+    s2.load_map(m)
+    assert (s2.current == s.current).all()
+
+
+def test_matches_one_shot_tpu_backend():
+    s = fresh_session()
+    prev_map, _ = s.to_map()
+    s.remove_nodes(["n0"])
+    s.replan()
+    dense_map, _ = s.to_map("proposed")
+
+    one_shot, _ = plan_next_map(
+        prev_map, prev_map, NODES, ["n0"], [], MODEL, PlanOptions(),
+        backend="tpu")
+    assert {p: m.nodes_by_state for p, m in dense_map.items()} == \
+        {p: m.nodes_by_state for p, m in one_shot.items()}
+
+
+def test_remove_replan_moves_apply_loop():
+    s = fresh_session()
+    before = s.current.copy()
+    s.remove_nodes(["n3"])
+    s.replan()
+    nodes, states, ops = s.moves()
+
+    # Every op row refers to this partition's transition; displaced copies
+    # from n3 produce adds elsewhere + dels on n3.
+    n3 = s.nodes.index("n3")
+    displaced = int((before == n3).sum())
+    flat_ops = ops[ops >= 0]
+    assert len(flat_ops) >= displaced  # at least one op per displaced copy
+    del_rows = ops == OP_NAMES.index("del")
+    assert (nodes[del_rows] == n3).all()
+
+    s.apply()
+    assert not (s.current == n3).any()
+    report = check_assignment(s.problem, s.current)
+    assert report["duplicates"] == 0 and report["on_removed_nodes"] == 0
+    # Sticky: partitions not touching n3 keep their primary.
+    untouched = ~(before == n3).any(axis=(1, 2))
+    assert (s.current[untouched, 0, 0] == before[untouched, 0, 0]).all()
+
+
+def test_add_nodes_attracts_load():
+    s = fresh_session()
+    s.add_nodes(["x0", "x1"])
+    assert "x0" in s.nodes and s.problem.N == 10
+    s.replan()
+    s.apply()
+    new_ids = [s.nodes.index("x0"), s.nodes.index("x1")]
+    counts = np.bincount(s.current[s.current >= 0], minlength=10)
+    assert all(counts[i] > 0 for i in new_ids)
+    report = check_assignment(s.problem, s.current)
+    assert report == {"duplicates": 0, "on_removed_nodes": 0,
+                      "unfilled_feasible_slots": 0}
+
+
+def test_readd_removed_node():
+    s = fresh_session()
+    s.remove_nodes(["n2"])
+    s.replan(); s.apply()
+    assert not (s.current == s.nodes.index("n2")).any()
+    s.add_nodes(["n2"])
+    assert s.removed_nodes == []
+    s.replan(); s.apply()
+    assert (s.current == s.nodes.index("n2")).any()
+
+
+def test_moves_requires_replan():
+    s = fresh_session()
+    with pytest.raises(ValueError):
+        s.moves()
+    with pytest.raises(ValueError):
+        s.to_map("proposed")
+
+
+def test_add_nodes_duplicates_in_one_call():
+    s = fresh_session()
+    s.add_nodes(["x0", "x0", "x0"])
+    assert s.nodes.count("x0") == 1
+    assert s.problem.N == len(NODES) + 1
+
+
+def test_load_map_rejects_unknown_nodes():
+    s = fresh_session()
+    bad = {name: Partition(name, {"primary": ["not-a-node"]})
+           for name in PARTS}
+    with pytest.raises(ValueError, match="not-a-node"):
+        s.load_map(bad)
+
+
+def test_load_map_rejects_unknown_partitions():
+    s = fresh_session()
+    with pytest.raises(ValueError, match="ghost"):
+        s.load_map({"ghost": Partition("ghost", {})})
