@@ -1,5 +1,11 @@
 """blance_tpu.ops — Pallas TPU kernels for the planner's hot ops."""
 
-from .reduce2 import min2_argmin, min2_argmin_reference, pallas_available
+from .reduce2 import (
+    min2_argmin,
+    min2_argmin_reference,
+    pallas_available,
+    priced_min2_argmin,
+)
 
-__all__ = ["min2_argmin", "min2_argmin_reference", "pallas_available"]
+__all__ = ["min2_argmin", "min2_argmin_reference", "pallas_available",
+           "priced_min2_argmin"]

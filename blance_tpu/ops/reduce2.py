@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["min2_argmin", "min2_argmin_reference", "pallas_available"]
+__all__ = ["min2_argmin", "min2_argmin_reference", "priced_min2_argmin",
+           "pallas_available"]
 
 _INF = float("inf")
 
@@ -54,7 +55,8 @@ def min2_argmin_reference(eff: jnp.ndarray):
     return best, choice, second
 
 
-def _kernel(x_ref, best_ref, idx_ref, second_ref, *, tile_n: int, n: int):
+def _kernel(x_ref, price_ref, best_ref, idx_ref, second_ref, *,
+            tile_n: int, n: int):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -63,7 +65,10 @@ def _kernel(x_ref, best_ref, idx_ref, second_ref, *, tile_n: int, n: int):
         second_ref[:] = jnp.full_like(second_ref, _INF)
         idx_ref[:] = jnp.zeros_like(idx_ref)
 
-    x = x_ref[:]  # [TP, TN]
+    # Fold the per-node price row in VMEM instead of materializing the
+    # priced matrix in HBM (the auction re-prices every round; without the
+    # fusion each round costs a full [P, N] write + read of `eff`).
+    x = x_ref[:] + price_ref[:]  # [TP, TN] + [1, TN]
     tp, tn = x.shape
     cols = jax.lax.broadcasted_iota(jnp.int32, (tp, tn), 1)
     # Mask the ragged N tail (pallas zero-fills partial blocks; a stray 0
@@ -97,19 +102,23 @@ def _kernel(x_ref, best_ref, idx_ref, second_ref, *, tile_n: int, n: int):
 
 
 @functools.partial(jax.jit, static_argnames=("tile_p", "tile_n", "interpret"))
-def min2_argmin(
-    eff: jnp.ndarray,
+def priced_min2_argmin(
+    score: jnp.ndarray,
+    price: jnp.ndarray,
     *,
     tile_p: int = 256,
     tile_n: int = 2048,
     interpret: bool = False,
 ):
-    """Fused (best, argmin, second-min) over axis 1 of ``eff[P, N]``.
+    """Fused (best, argmin, second-min) over axis 1 of ``score + price``.
 
-    Returns ``(best[P] f32, choice[P] i32, second[P] f32)`` — bit-identical
-    to :func:`min2_argmin_reference`.
+    ``price[N]`` is the auction's per-node additive term (in-slot price +
+    closed-node penalty); it is broadcast-added inside the kernel so the
+    priced matrix never exists in HBM.  Returns ``(best[P] f32,
+    choice[P] i32, second[P] f32)`` — bit-identical to
+    ``min2_argmin_reference(score + price[None, :])``.
     """
-    p, n = eff.shape
+    p, n = score.shape
     if n == 0:
         # A zero-size row reduction has no defined argmin; fail loudly like
         # the XLA oracle instead of returning never-written buffers.
@@ -133,12 +142,27 @@ def min2_argmin(
         functools.partial(_kernel, tile_n=tn, n=n),
         out_shape=out_shape,
         grid=grid,
-        in_specs=[pl.BlockSpec((tp, tn), lambda i, j: (i, j))],
+        in_specs=[pl.BlockSpec((tp, tn), lambda i, j: (i, j)),
+                  pl.BlockSpec((1, tn), lambda i, j: (0, j))],
         out_specs=[out_spec, out_spec, out_spec],
         interpret=interpret,
-    )(eff)
+    )(score, price.reshape(1, n).astype(jnp.float32))
 
     return best[:, 0], idx[:, 0], second[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_p", "tile_n", "interpret"))
+def min2_argmin(
+    eff: jnp.ndarray,
+    *,
+    tile_p: int = 256,
+    tile_n: int = 2048,
+    interpret: bool = False,
+):
+    """Fused (best, argmin, second-min) over axis 1 of ``eff[P, N]``."""
+    return priced_min2_argmin(
+        eff, jnp.zeros(eff.shape[1], jnp.float32),
+        tile_p=tile_p, tile_n=tile_n, interpret=interpret)
 
 
 def pallas_available() -> bool:

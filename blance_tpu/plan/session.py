@@ -137,30 +137,26 @@ class PlannerSession:
         are dropped (the session covers modeled states only; keep the
         PartitionMap if you need unmodeled-state passthrough).
         """
+        # Validate BEFORE re-encoding so a rejected map leaves the session's
+        # state (problem statics included) untouched.
         unknown_parts = set(prev_map) - set(self._partition_names)
         if unknown_parts:
             raise ValueError(
                 "load_map: partitions outside this session: "
                 f"{sorted(unknown_parts)[:8]}")
-        self._reencode(prev_map=prev_map)
         modeled = set(self._problem.states)
         known = self._node_index
-        expected = 0
-        for partition in prev_map.values():
-            for sname, ns in partition.nodes_by_state.items():
-                if sname in modeled:
-                    expected += len(ns)
-        got = int((self._problem.prev >= 0).sum())
-        if got != expected:
-            unknown = sorted({
-                node
-                for partition in prev_map.values()
-                for sname, ns in partition.nodes_by_state.items()
-                if sname in modeled
-                for node in ns if node not in known})
+        unknown = sorted({
+            node
+            for partition in prev_map.values()
+            for sname, ns in partition.nodes_by_state.items()
+            if sname in modeled
+            for node in ns if node not in known})
+        if unknown:
             raise ValueError(
-                f"load_map: {expected - got} placements not representable; "
-                f"unknown nodes: {unknown[:8]}")
+                "load_map: placements on nodes outside this session "
+                f"(would be silently dropped): {unknown[:8]}")
+        self._reencode(prev_map=prev_map)
         self.current = self._problem.prev.copy()
         self.proposed = None
 
@@ -170,6 +166,8 @@ class PlannerSession:
         """Materialize ``current`` or ``proposed`` as (PartitionMap,
         warnings); the session's checkpoint format, like the reference's
         JSON-taggable maps (api.go:30-35)."""
+        if which not in ("current", "proposed"):
+            raise ValueError(f"to_map: unknown which={which!r}")
         assign = self.proposed if which == "proposed" else self.current
         if assign is None:
             raise ValueError("no proposed assignment; call replan() first")

@@ -46,7 +46,11 @@ from jax import lax
 
 from ..core.encode import DenseProblem, decode_assignment, encode_problem
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
-from ..ops.reduce2 import min2_argmin, min2_argmin_reference, pallas_available
+from ..ops.reduce2 import (
+    min2_argmin_reference,
+    pallas_available,
+    priced_min2_argmin,
+)
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "check_assignment"]
 
@@ -144,21 +148,28 @@ def _assign_slot(
               & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
     score = score + jitter_scale * jitter
 
+    # Loop-invariant: phase B consults the unpriced per-row best to decide
+    # whether a straggler still has rule-satisfying options.  Computed once
+    # here — XLA cannot hoist a [P, N] reduction out of the while_loop body
+    # on its own.
+    raw_best_all = jnp.min(score, axis=1)
+
     def round_body(carry):
         slot_assign, unassigned, rem_cap, used, _progress, it = carry
 
-        open_pen = jnp.where(rem_cap > 0, 0.0, _INF)[None, :]
         # Price: weight already accepted this slot raises a node's cost as
         # if the counts term had updated, so bids keep spreading even
-        # within one slot wave.
-        eff = score + (used * price_scale)[None, :] + open_pen
-        # Fused (min, argmin, second-min) — a single HBM pass via the Pallas
-        # kernel on TPU (blance_tpu/ops/reduce2.py); the XLA spelling
-        # (3 reductions + a full [P, N] position-mask copy) elsewhere.
+        # within one slot wave; closed nodes cost +_INF.
+        price_vec = used * price_scale + jnp.where(rem_cap > 0, 0.0, _INF)
+        # Fused (min, argmin, second-min) over score + price — one HBM pass
+        # with the price row folded in VMEM via the Pallas kernel on TPU
+        # (blance_tpu/ops/reduce2.py); the XLA spelling (priced [P, N]
+        # materialization + 3 reductions + a position-mask copy) elsewhere.
         if pallas_available():
-            best, choice, second = min2_argmin(eff)
+            best, choice, second = priced_min2_argmin(score, price_vec)
         else:
-            best, choice, second = min2_argmin_reference(eff)
+            best, choice, second = min2_argmin_reference(
+                score + price_vec[None, :])
         margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
 
         active = unassigned & (best < _INF / 2)
@@ -222,7 +233,7 @@ def _assign_slot(
         choice2 = node_order[jnp.clip(pos, 0, n - 1)]
 
         raw2 = score[sperm, choice2]
-        raw_best = jnp.min(score, axis=1)[sperm]
+        raw_best = raw_best_all[sperm]
         hard_ok = raw2 < _INF / 2
         soft_ok = (raw2 < _RULE_MISS / 2) | (raw_best >= _RULE_MISS / 2)
         accept2_s = s_mask & in_range & hard_ok & soft_ok

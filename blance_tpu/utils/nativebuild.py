@@ -24,8 +24,8 @@ def compile_cached(source: str, out_path: str, command: list[str]) -> bool:
     """
     if not os.path.exists(source):
         return False
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
     try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
         if (not os.path.exists(out_path)
                 or os.path.getmtime(out_path) < os.path.getmtime(source)):
             subprocess.run(command, check=True, capture_output=True)

@@ -60,3 +60,22 @@ def test_inf_rows():
     x = np.full((4, 20), np.inf, np.float32)
     x[1, 3] = 7.0
     _check(x, tile_p=2, tile_n=16)
+
+
+@pytest.mark.parametrize("shape", [(7, 5), (33, 513)])
+def test_priced_variant_matches_materialized(shape):
+    """priced_min2_argmin(score, price) == oracle(score + price[None, :]) —
+    the auction-loop contract (price folded in VMEM, never in HBM)."""
+    from blance_tpu.ops.reduce2 import priced_min2_argmin
+
+    rng = np.random.default_rng(hash(shape) % 2**31)
+    score = rng.standard_normal(shape).astype(np.float32)
+    price = (rng.random(shape[1]) * 3).astype(np.float32)
+    price[::4] = 1e9  # closed nodes
+    b0, i0, s0 = min2_argmin_reference(jnp.asarray(score + price[None, :]))
+    b1, i1, s1 = priced_min2_argmin(
+        jnp.asarray(score), jnp.asarray(price), tile_p=8, tile_n=128,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(b0), np.asarray(b1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))

@@ -40,8 +40,9 @@ def build_maps(n_parts, nodes, rng):
     return pm(beg), pm(end)
 
 
+@pytest.mark.parametrize("interrupt", [True, False])
 @pytest.mark.parametrize("seed", range(5))
-def test_random_pause_resume_stop_orderings(seed):
+def test_random_pause_resume_stop_orderings(seed, interrupt):
     rng = random.Random(seed)
     nodes = ["a", "b", "c", "d"]
     beg, end = build_maps(8, nodes, rng)
@@ -56,7 +57,8 @@ def test_random_pause_resume_stop_orderings(seed):
         o = orchestrate_moves(
             MODEL,
             OrchestratorOptions(
-                max_concurrent_partition_moves_per_node=rng.choice([1, 2, 3])),
+                max_concurrent_partition_moves_per_node=rng.choice([1, 2, 3]),
+                interrupt_on_first_feed=interrupt),
             nodes, beg, end, assign)
 
         stop_after = rng.randint(0, 40)
