@@ -116,6 +116,58 @@ def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name else x
 
 
+def _pin_prev_holders(
+    prev_slot: jnp.ndarray,  # [P] node id or -1
+    pin_ok: jnp.ndarray,  # [P] eligible to keep its previous node
+    pweights: jnp.ndarray,  # [P]
+    cap: jnp.ndarray,  # [N]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Capacity-capped warm start: returns (pinned[P] bool, used[N]).
+
+    Eligible previous holders keep their node up to its capacity, in
+    partition order (deterministic).  The same first-holder progress rule
+    as the auction applies, so an oversize partition still pins to a node
+    with any capacity at all.  Everything else goes to the auction.
+    """
+    p = prev_slot.shape[0]
+    n = cap.shape[0]
+    safe = _drop_empty(prev_slot, n)
+    pin_w = jnp.where(pin_ok, pweights, 0.0)
+    node_w = jnp.zeros(n, jnp.float32).at[safe].add(pin_w, mode="drop")
+
+    def keep_all(_):
+        # Common case (shrinking/steady cluster: caps only grew): every
+        # eligible holder fits — no ordering pass needed.
+        return pin_ok
+
+    def trim(_):
+        # Some node over-caps (cluster grew, its share shrank): keep
+        # holders in partition order up to capacity; the first holder
+        # always stays (auction progress rule).
+        sort_node = jnp.where(pin_ok, prev_slot, n)
+        perm = jnp.argsort(sort_node, stable=True)  # groups by node
+        node_s = sort_node[perm]
+        ok_s = pin_ok[perm]
+        w_s = jnp.where(ok_s, pweights[perm], 0.0)
+
+        csum = jnp.cumsum(w_s)
+        ecs = csum - w_s
+        seg_start = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), node_s[1:] != node_s[:-1]])
+        seg_base = lax.cummax(jnp.where(seg_start, ecs, -jnp.inf))
+        before_me = ecs - seg_base
+
+        cap_here = cap[jnp.clip(node_s, 0, n - 1)]
+        keep_s = ok_s & (
+            (before_me + w_s <= cap_here) | (before_me == 0.0) & (cap_here > 0))
+        return jnp.zeros(p, jnp.bool_).at[perm].set(keep_s)
+
+    pinned = lax.cond(jnp.any(node_w > cap), trim, keep_all, None)
+    used = jnp.zeros(n, jnp.float32).at[safe].add(
+        jnp.where(pinned, pweights, 0.0), mode="drop")
+    return pinned, used
+
+
 def _assign_slot(
     score: jnp.ndarray,  # [P, N] (forbidden already folded in as +_INF)
     pweights: jnp.ndarray,  # [P]
@@ -123,12 +175,16 @@ def _assign_slot(
     price_scale: jnp.ndarray,  # [N] converts accepted weight into score units
     jitter_scale: jnp.ndarray,  # scalar, <= half the smallest real delta
     axis_name: Optional[str],
+    init_assign: Optional[jnp.ndarray] = None,  # [P] warm-start (or -1)
+    init_used: Optional[jnp.ndarray] = None,  # [N] weight behind the warm start
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Auction: returns (slot_assign[P] int32 node id or -1, used[N] weight).
 
     Each round: bid on the best open node, accept most-urgent bidders up to
     remaining capacity (at least the first bidder per node, to guarantee
     progress), repeat.  Ends when everyone is assigned or nothing moved.
+    ``init_assign``/``init_used`` seed the loop with pre-pinned placements
+    (the warm start); pinned partitions never rebid.
 
     Entirely shard-local: under shard_map the caller hands each shard its
     slice of capacity and psums the returned per-node usage afterwards —
@@ -255,11 +311,15 @@ def _assign_slot(
         _, unassigned, _, _, progress, it = carry
         return jnp.any(unassigned) & progress & (it < _MAX_AUCTION_ROUNDS)
 
+    if init_assign is None:
+        init_assign = jnp.full(p, -1, jnp.int32)
+    if init_used is None:
+        init_used = jnp.zeros(n, jnp.float32)
     init = (
-        jnp.full(p, -1, jnp.int32),
-        jnp.ones(p, jnp.bool_),
-        cap,
-        jnp.zeros(n, jnp.float32),
+        init_assign,
+        init_assign < 0,
+        cap - init_used,
+        init_used,
         jnp.array(True),
         jnp.array(0, jnp.int32),
     )
@@ -371,6 +431,12 @@ def solve_dense(
             if si > 0 else top_anchor
         hier = _hier_penalty(anchor, gids, gid_valid, rules[si]) \
             if rules[si] else 0.0
+        # Best attainable rule tier per partition (over surviving nodes):
+        # pins must not freeze a fallback-tier placement when a preferred
+        # tier is reachable — the 1e4 tier gap outweighs stickiness in the
+        # auction, and pinning must not override that.
+        hier_floor = jnp.min(jnp.where(valid[None, :], hier, _INF), axis=1) \
+            if rules[si] else None
 
         for ri in range(k):
             balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
@@ -405,8 +471,31 @@ def solve_dense(
                 extra = ((node_ids + idx) % ns) < rem.astype(jnp.int32)
                 cap = base_cap + extra.astype(jnp.float32)
 
+            # Warm start: a previous holder of this exact (state, slot)
+            # whose node survives, isn't taken by a higher-priority state,
+            # and sits at the best ATTAINABLE hierarchy-rule tier keeps its
+            # place up to capacity — churn becomes structural, not a
+            # price-dynamics accident (the batch analog of stickiness,
+            # plan.go:654-662; cross-checked against CalcPartitionMoves'
+            # lower bound in tests).  A fallback-tier placement does NOT
+            # pin when a preferred tier is reachable, so constrained-period
+            # degradations heal on the next rebalance.  Only the
+            # displaced/overflow copies enter the auction.  (ri < r_max is
+            # guaranteed: solve_dense rejects r_max < max(constraints).)
+            prev_slot = prev[:, si, ri]
+            safe_prev = jnp.clip(prev_slot, 0, n - 1)
+            pin_ok = (prev_slot >= 0) & valid[safe_prev] & \
+                ~taken[jnp.arange(p), safe_prev]
+            if rules[si]:
+                pin_ok &= hier[jnp.arange(p), safe_prev] < \
+                    hier_floor + _RULE_TIER * 0.5
+            pinned, pin_used = _pin_prev_holders(
+                prev_slot, pin_ok, pweights, cap)
+            init_assign = jnp.where(pinned, prev_slot, -1)
+
             slot_assign, used = _assign_slot(
-                score, pweights, cap, 1.0 / w_div, jitter_scale, axis_name)
+                score, pweights, cap, 1.0 / w_div, jitter_scale, axis_name,
+                init_assign=init_assign, init_used=pin_used)
             used = _psum(used, axis_name)  # global per-node accepted weight
 
             assign = assign.at[:, si, ri].set(slot_assign)
