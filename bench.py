@@ -55,7 +55,7 @@ def build_dense(P, N, seed=0):
 def bench_tpu():
     import jax
     import jax.numpy as jnp
-    from blance_tpu.plan.tensor import solve_dense
+    from blance_tpu.plan.tensor import solve_dense_converged
 
     args = build_dense(P_FULL, N_NODES)
     (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
@@ -68,7 +68,9 @@ def bench_tpu():
     # block_until_ready is unreliable on the experimental axon platform, so
     # force completion with a small host copy ([P] primaries, ~400KB).
     def run():
-        out = solve_dense(*dev_args, constraints, rules)
+        # The production path: solve iterated to the reference's fixpoint
+        # (pass 2+ short-circuits through the warm-start pins).
+        out = solve_dense_converged(*dev_args, constraints, rules)
         np.asarray(out[:, 0, 0])
         return out
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.encode import DenseProblem
-from ..plan.tensor import solve_dense
+from ..plan.tensor import solve_dense_converged
 
 __all__ = ["make_mesh", "solve_dense_sharded", "pad_partitions"]
 
@@ -69,8 +69,9 @@ def solve_dense_sharded(
     gid_valid: np.ndarray,
     constraints: tuple,
     rules: tuple,
+    max_iterations: int = 10,
 ) -> np.ndarray:
-    """Run solve_dense under shard_map with the partition axis sharded.
+    """Run the converged solve under shard_map, partition axis sharded.
 
     Returns assign[P_original, S, R] (padding stripped).
     """
@@ -86,10 +87,11 @@ def solve_dense_sharded(
 
     fn = jax.shard_map(
         partial(
-            solve_dense,
+            solve_dense_converged,
             constraints=constraints,
             rules=rules,
             axis_name=PARTITION_AXIS,
+            max_iterations=max_iterations,
         ),
         mesh=mesh,
         in_specs=(shard, shard, rep, rep, shard, rep, rep),

@@ -182,7 +182,7 @@ class PlannerSession:
         the proposed assignment (does not adopt it — see apply())."""
         import jax.numpy as jnp
 
-        from .tensor import solve_dense
+        from .tensor import solve_dense_converged
 
         prob = self._problem
         rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
@@ -191,15 +191,17 @@ class PlannerSession:
             self.proposed = self.current.copy()
             return self.proposed
 
+        iters = max(int(self.opts.max_iterations), 1)
         if self.mesh is not None:
             from ..parallel.sharded import solve_dense_sharded
 
             assign = solve_dense_sharded(
                 self.mesh, self.current, prob.partition_weights,
                 prob.node_weights, prob.valid_node, prob.stickiness,
-                prob.gids, prob.gid_valid, constraints, rules)
+                prob.gids, prob.gid_valid, constraints, rules,
+                max_iterations=iters)
         else:
-            assign = np.asarray(solve_dense(
+            assign = np.asarray(solve_dense_converged(
                 jnp.asarray(self.current),
                 jnp.asarray(prob.partition_weights),
                 jnp.asarray(prob.node_weights),
@@ -207,7 +209,7 @@ class PlannerSession:
                 jnp.asarray(prob.stickiness),
                 jnp.asarray(prob.gids),
                 jnp.asarray(prob.gid_valid),
-                constraints, rules))
+                constraints, rules, max_iterations=iters))
         self.proposed = assign
         return assign
 

@@ -52,7 +52,8 @@ from ..ops.reduce2 import (
     priced_min2_argmin,
 )
 
-__all__ = ["plan_next_map_tpu", "solve_dense", "check_assignment"]
+__all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
+           "check_assignment"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -116,24 +117,88 @@ def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name else x
 
 
+def _shard_capacity(cap: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
+    """Split global per-node capacity into integral per-shard shares.
+
+    Fractional caps + the first-bidder progress rule would overshoot, so
+    each shard gets floor(cap/ns) with the remainder rotated by node index
+    so no shard systematically holds the extras.
+    """
+    if not axis_name:
+        return cap
+    ns = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    base_cap = jnp.floor(cap / ns)
+    rem = cap - base_cap * ns
+    node_ids = jnp.arange(cap.shape[0], dtype=jnp.int32)
+    extra = ((node_ids + idx) % ns) < rem.astype(jnp.int32)
+    return base_cap + extra.astype(jnp.float32)
+
+
+def _segment_accept(
+    node_s: jnp.ndarray,  # [K] node ids, sorted so equal nodes are adjacent
+    ok_s: jnp.ndarray,  # [K] participating entries
+    w_s: jnp.ndarray,  # [K] weights (0 where not participating)
+    cap_here: jnp.ndarray,  # [K] per-entry capacity budget (node's cap)
+) -> jnp.ndarray:
+    """Per-node prefix acceptance: keep entries while the running weight on
+    their node fits ``cap_here``; the first entry per node always fits if
+    the node has any capacity (the auction's progress rule).  The single
+    capacity-acceptance idiom shared by the auction rounds and the
+    warm-start pins — one accept rule, enforced identically in both."""
+    csum = jnp.cumsum(w_s)
+    ecs = csum - w_s  # exclusive prefix over ALL entries
+    seg_start = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), node_s[1:] != node_s[:-1]])
+    seg_base = lax.cummax(jnp.where(seg_start, ecs, -jnp.inf))
+    before_me = ecs - seg_base  # weight of earlier entries on my node
+    return ok_s & (
+        (before_me + w_s <= cap_here) | (before_me == 0.0) & (cap_here > 0))
+
+
 def _pin_prev_holders(
     prev_slot: jnp.ndarray,  # [P] node id or -1
     pin_ok: jnp.ndarray,  # [P] eligible to keep its previous node
     pweights: jnp.ndarray,  # [P]
-    cap: jnp.ndarray,  # [N]
+    cap: jnp.ndarray,  # [N] GLOBAL capacity for this state
+    slack: jnp.ndarray,  # [P] per-holder capacity tolerance (stickiness)
+    axis_name: Optional[str],
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Capacity-capped warm start: returns (pinned[P] bool, used[N]).
 
-    Eligible previous holders keep their node up to its capacity, in
-    partition order (deterministic).  The same first-holder progress rule
-    as the auction applies, so an oversize partition still pins to a node
-    with any capacity at all.  Everything else goes to the auction.
+    Eligible previous holders keep their node up to its capacity plus the
+    holder's stickiness ``slack``, in partition order (deterministic).  The
+    slack is what makes replanning a FIXPOINT: a fresh plan can leave a
+    node one unit over the ceil capacity (first-bidder progress rule), and
+    the reference's semantics keep a sticky holder unless moving improves
+    balance by more than its stickiness (plan.go:654-662) — so pins
+    tolerate the same overshoot instead of churning it.  The same marginal
+    rule cuts the other way: when some node is under-loaded by MORE than
+    the stickiness (a fresh node joining), moving there is profitable and
+    the slack switches off, so growth still migrates load.  The first
+    holder per node always stays (auction progress rule).  Everything else
+    goes to the auction.
     """
     p = prev_slot.shape[0]
     n = cap.shape[0]
     safe = _drop_empty(prev_slot, n)
     pin_w = jnp.where(pin_ok, pweights, 0.0)
-    node_w = jnp.zeros(n, jnp.float32).at[safe].add(pin_w, mode="drop")
+    node_w_local = jnp.zeros(n, jnp.float32).at[safe].add(pin_w, mode="drop")
+    # Deficit and over-capacity are GLOBAL questions — under shard_map each
+    # shard holds an arbitrary subset of a node's holders, so the shard-
+    # local weight says nothing about whether the node is full.
+    node_w = _psum(node_w_local, axis_name)
+    # Deficit of the emptiest node (removed nodes have cap 0, so they
+    # can't fake one).  Holders whose stickiness is below it lose their
+    # slack — the auction will fill that node with them.
+    max_deficit = jnp.max(cap - node_w) if n else jnp.float32(0)
+    slack = jnp.where(max_deficit > slack, 0.0, slack)
+
+    # The trim quota must be shard-local (each shard admits only its
+    # integral share of a node's capacity, remainder rotated — the same
+    # split the auction uses) or every shard would admit up to the global
+    # cap and overshoot by the shard count.
+    cap_quota = _shard_capacity(cap, axis_name)
 
     def keep_all(_):
         # Common case (shrinking/steady cluster: caps only grew): every
@@ -142,24 +207,14 @@ def _pin_prev_holders(
 
     def trim(_):
         # Some node over-caps (cluster grew, its share shrank): keep
-        # holders in partition order up to capacity; the first holder
-        # always stays (auction progress rule).
+        # holders in partition order up to capacity + slack.
         sort_node = jnp.where(pin_ok, prev_slot, n)
         perm = jnp.argsort(sort_node, stable=True)  # groups by node
         node_s = sort_node[perm]
         ok_s = pin_ok[perm]
         w_s = jnp.where(ok_s, pweights[perm], 0.0)
-
-        csum = jnp.cumsum(w_s)
-        ecs = csum - w_s
-        seg_start = jnp.concatenate(
-            [jnp.ones(1, jnp.bool_), node_s[1:] != node_s[:-1]])
-        seg_base = lax.cummax(jnp.where(seg_start, ecs, -jnp.inf))
-        before_me = ecs - seg_base
-
-        cap_here = cap[jnp.clip(node_s, 0, n - 1)]
-        keep_s = ok_s & (
-            (before_me + w_s <= cap_here) | (before_me == 0.0) & (cap_here > 0))
+        cap_here = cap_quota[jnp.clip(node_s, 0, n - 1)] + slack[perm]
+        keep_s = _segment_accept(node_s, ok_s, w_s, cap_here)
         return jnp.zeros(p, jnp.bool_).at[perm].set(keep_s)
 
     pinned = lax.cond(jnp.any(node_w > cap), trim, keep_all, None)
@@ -243,17 +298,9 @@ def _assign_slot(
         w_s = pweights[perm]
         active_s = active[perm]
 
-        w_eff = jnp.where(active_s, w_s, 0.0)
-        csum = jnp.cumsum(w_eff)
-        ecs = csum - w_eff  # exclusive: weight of earlier bidders overall
-        seg_start = jnp.concatenate(
-            [jnp.ones(1, jnp.bool_), choice_s[1:] != choice_s[:-1]])
-        seg_base = lax.cummax(jnp.where(seg_start, ecs, -jnp.inf))
-        before_me = ecs - seg_base  # weight of earlier bidders on my node
-
-        cap_here = rem_cap[choice_s]
-        accept_s = active_s & (
-            (before_me + w_s <= cap_here) | (before_me == 0.0) & (cap_here > 0))
+        accept_s = _segment_accept(
+            choice_s, active_s, jnp.where(active_s, w_s, 0.0),
+            rem_cap[choice_s])
 
         accept = jnp.zeros(p, jnp.bool_).at[perm].set(accept_s)
         slot_assign = jnp.where(accept, choice, slot_assign)
@@ -438,6 +485,49 @@ def solve_dense(
         hier_floor = jnp.min(jnp.where(valid[None, :], hier, _INF), axis=1) \
             if rules[si] else None
 
+        # Warm start, decided per STATE across all k ordinals: a previous
+        # holder whose node survives, isn't taken by a higher-priority
+        # state, and sits at the best attainable rule tier keeps its place
+        # up to the node's state-level capacity — churn becomes structural,
+        # not a price-dynamics accident (the batch analog of stickiness,
+        # plan.go:654-662).  State-level, because ordinal packing within a
+        # state is arbitrary (a node legitimately holds many slot-1 copies
+        # if it holds few slot-0 copies); judging pins per slot would trim
+        # balanced placements and break the replan fixpoint.
+        kk = min(k, r_max)
+        prev_k = prev[:, si, :kk]  # [P, kk]
+        safe_k = jnp.clip(prev_k, 0, n - 1)
+        rows = jnp.arange(p)[:, None]
+        pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken[rows, safe_k]
+        # An externally supplied prev map can repeat a node within one
+        # state's row; only the first occurrence may pin, or both copies
+        # would keep the same node — a duplicate the auction's exclusivity
+        # mask can no longer prevent (the converged loop would then carry
+        # it forever).  kk is small, so the pairwise check unrolls.
+        for j in range(1, kk):
+            dup = jnp.zeros(p, jnp.bool_)
+            for i in range(j):
+                dup |= (prev_k[:, j] == prev_k[:, i]) & (prev_k[:, j] >= 0)
+            pin_ok_k = pin_ok_k.at[:, j].set(pin_ok_k[:, j] & ~dup)
+        if rules[si]:
+            pin_ok_k &= hier[rows, safe_k] < \
+                (hier_floor[:, None] + _RULE_TIER * 0.5)
+        state_cap = jnp.ceil(k * total_w * cap_share)
+        pins_flat, _ = _pin_prev_holders(
+            prev_k.reshape(-1),
+            pin_ok_k.reshape(-1),
+            jnp.repeat(pweights, kk),
+            state_cap,
+            jnp.repeat(stickiness[:, si], kk),
+            axis_name,
+        )
+        pins = pins_flat.reshape(p, kk)
+        # Same-partition exclusivity: later ordinals' pins must be invisible
+        # to earlier ordinals' auctions, or a displaced slot-0 copy could
+        # land on the node slot-1 keeps pinned.
+        taken = taken.at[rows, jnp.where(pins, safe_k, n)].set(
+            True, mode="drop")
+
         for ri in range(k):
             balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
             score = balance / w_div[None, :]
@@ -457,41 +547,17 @@ def solve_dense(
             # Exact ceil capacity: the binding rail that yields tight
             # balance; exclusivity stragglers rebid under the in-slot price
             # and, in the worst case, the force step places them.
-            cap = jnp.ceil(total_w * cap_share)
-            if axis_name:
-                # Split each node's capacity into integral per-shard shares
-                # (fractional caps + the first-bidder progress rule would
-                # overshoot).  The remainder rotates with the node index so
-                # no shard systematically holds the extras.
-                ns = lax.axis_size(axis_name)
-                idx = lax.axis_index(axis_name)
-                base_cap = jnp.floor(cap / ns)
-                rem = cap - base_cap * ns
-                node_ids = jnp.arange(cap.shape[0], dtype=jnp.int32)
-                extra = ((node_ids + idx) % ns) < rem.astype(jnp.int32)
-                cap = base_cap + extra.astype(jnp.float32)
+            cap = _shard_capacity(jnp.ceil(total_w * cap_share), axis_name)
 
-            # Warm start: a previous holder of this exact (state, slot)
-            # whose node survives, isn't taken by a higher-priority state,
-            # and sits at the best ATTAINABLE hierarchy-rule tier keeps its
-            # place up to capacity — churn becomes structural, not a
-            # price-dynamics accident (the batch analog of stickiness,
-            # plan.go:654-662; cross-checked against CalcPartitionMoves'
-            # lower bound in tests).  A fallback-tier placement does NOT
-            # pin when a preferred tier is reachable, so constrained-period
-            # degradations heal on the next rebalance.  Only the
-            # displaced/overflow copies enter the auction.  (ri < r_max is
-            # guaranteed: solve_dense rejects r_max < max(constraints).)
-            prev_slot = prev[:, si, ri]
-            safe_prev = jnp.clip(prev_slot, 0, n - 1)
-            pin_ok = (prev_slot >= 0) & valid[safe_prev] & \
-                ~taken[jnp.arange(p), safe_prev]
-            if rules[si]:
-                pin_ok &= hier[jnp.arange(p), safe_prev] < \
-                    hier_floor + _RULE_TIER * 0.5
-            pinned, pin_used = _pin_prev_holders(
-                prev_slot, pin_ok, pweights, cap)
-            init_assign = jnp.where(pinned, prev_slot, -1)
+            # This ordinal's share of the state-level pins; only displaced
+            # or over-capacity copies enter the auction below.
+            if ri < kk:
+                init_assign = jnp.where(pins[:, ri], prev[:, si, ri], -1)
+            else:
+                init_assign = jnp.full(p, -1, jnp.int32)
+            pin_used = jnp.zeros(n, jnp.float32).at[
+                _drop_empty(init_assign, n)].add(
+                jnp.where(init_assign >= 0, pweights, 0.0), mode="drop")
 
             slot_assign, used = _assign_slot(
                 score, pweights, cap, 1.0 / w_div, jitter_scale, axis_name,
@@ -504,6 +570,52 @@ def solve_dense(
             taken = taken.at[jnp.arange(p), safe_slot].set(True, mode="drop")
 
     return assign
+
+
+@partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
+                                   "max_iterations"))
+def solve_dense_converged(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    max_iterations: int = 10,
+) -> jnp.ndarray:
+    """solve_dense iterated to a fixpoint (reference plan.go:23-58).
+
+    The reference replans on its own output until stable (≤ 10 passes,
+    "usually 1 or 2"): the first pass does the work, later passes converge
+    because the warm-start pins hold everything the capacity rail accepts.
+    A converged pass short-circuits the auction (every copy pins), so the
+    confirming iteration costs a fraction of the first.  Like the
+    reference, cluster deltas apply only to the first pass — subsequent
+    passes re-balance on the stable node set (plan.go:49-55; removed nodes
+    hold nothing after pass 1, so a constant valid mask is equivalent).
+    """
+    first = solve_dense(prev, pweights, nweights, valid, stickiness,
+                        gids, gid_valid, constraints, rules, axis_name)
+
+    def cond(carry):
+        out, prev_i, it = carry
+        changed = jnp.any(out != prev_i)
+        if axis_name:
+            changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
+        return changed & (it < max_iterations)
+
+    def body(carry):
+        out, _prev, it = carry
+        nxt = solve_dense(out, pweights, nweights, valid, stickiness,
+                          gids, gid_valid, constraints, rules, axis_name)
+        return nxt, out, it + 1
+
+    out, _, _ = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
+    return out
 
 
 def check_assignment(
@@ -572,7 +684,7 @@ def plan_next_map_tpu(
         tuple(problem.rules.get(si, ())) for si in range(problem.S))
     constraints = tuple(int(c) for c in problem.constraints)
 
-    assign = solve_dense(
+    assign = solve_dense_converged(
         jnp.asarray(problem.prev),
         jnp.asarray(problem.partition_weights),
         jnp.asarray(problem.node_weights),
@@ -582,6 +694,7 @@ def plan_next_map_tpu(
         jnp.asarray(problem.gid_valid),
         constraints,
         rules,
+        max_iterations=max(int(opts.max_iterations), 1),
     )
     return decode_assignment(
         problem, np.asarray(assign), partitions_to_assign, nodes_to_remove)

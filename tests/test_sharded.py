@@ -61,3 +61,30 @@ def test_sharded_node_removal():
     for p in end.values():
         for ns in p.nodes_by_state.values():
             assert "n0" not in ns
+
+
+def test_sharded_growth_migrates_pinned_load():
+    """Cluster growth under shard_map: warm-start pins must judge capacity
+    GLOBALLY (shard-local holder weight says nothing about a node being
+    full), so new nodes attract load instead of staying empty."""
+    old_nodes = [f"n{i}" for i in range(8)]
+    all_nodes = old_nodes + ["x0", "x1", "x2", "x3", "x4", "x5", "x6", "x7"]
+    parts = empty_parts(128)
+    mesh = make_mesh(8)
+
+    # Steady placement on the 8 old nodes.
+    prob1 = encode_problem(empty_parts(128), parts, old_nodes, [], M_1P_1R,
+                           PlanOptions())
+    a1 = solve_problem_sharded(mesh, prob1)
+    m1, _ = decode_assignment(prob1, a1, parts, [])
+
+    # Double the cluster; replan from the warm map.
+    prob2 = encode_problem(m1, parts, all_nodes, [], M_1P_1R, PlanOptions())
+    a2 = solve_problem_sharded(mesh, prob2)
+    counts = np.bincount(a2[a2 >= 0], minlength=16)
+    # Every new node ends up holding something (256 copies / 16 nodes = 16).
+    assert (counts[8:] > 0).all(), counts
+    assert counts.max() - counts.min() <= 6, counts
+    report = check_assignment(prob2, a2)
+    assert report == {"duplicates": 0, "on_removed_nodes": 0,
+                      "unfilled_feasible_slots": 0}

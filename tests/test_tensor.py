@@ -257,3 +257,62 @@ def test_pin_does_not_freeze_fallback_tier():
     assert nxt["p"].nodes_by_state["primary"] == ["a0"]
     assert nxt["p"].nodes_by_state["replica"] == ["a1"], \
         nxt["p"].nodes_by_state
+
+
+def test_replan_is_fixpoint():
+    """With pin-first warm start, re-planning an already-balanced map with
+    no cluster delta must return it unchanged (the batch analog of the
+    reference's convergence-loop fixpoint, plan.go:23-58)."""
+    import blance_tpu as bt
+
+    nodes = [f"n{i}" for i in range(12)]
+    parts = empty_parts(144)
+    m1, _ = plan_next_map(parts, parts, nodes, [], nodes, M_1P_2R,
+                          backend="tpu")
+    m2, _ = plan_next_map(m1, m1, nodes, [], [], M_1P_2R, backend="tpu")
+    changed = [p for p in m1
+               if m1[p].nodes_by_state != m2[p].nodes_by_state]
+    assert changed == [], f"{len(changed)} partitions changed on replan"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_contract_random_configs(seed):
+    """Randomized configs (weights, racks, removals): the TPU backend must
+    always produce zero hard violations and fill every feasible slot."""
+    import blance_tpu as bt
+
+    rng = np.random.default_rng(seed)
+    N = int(rng.integers(4, 24))
+    P = int(rng.integers(8, 200))
+    R = int(rng.integers(1, 3))
+    nodes = [f"n{i}" for i in range(N)]
+    m = model(primary=(0, 1), replica=(1, R))
+    opts_kw = {}
+    if rng.random() < 0.5:
+        opts_kw["node_weights"] = {
+            nodes[i]: int(rng.integers(1, 4)) for i in range(0, N, 3)}
+    if rng.random() < 0.5:
+        opts_kw["partition_weights"] = {
+            str(i): int(rng.integers(1, 4)) for i in range(0, P, 5)}
+    racks = int(rng.integers(0, 4))
+    if racks >= 2:
+        hier = {n: f"r{i % racks}" for i, n in enumerate(nodes)}
+        hier.update({f"r{i}": "z" for i in range(racks)})
+        opts_kw["node_hierarchy"] = hier
+        opts_kw["hierarchy_rules"] = {"replica": [HierarchyRule(2, 1)]}
+    opts = PlanOptions(**opts_kw)
+
+    parts = empty_parts(P)
+    m1, _ = plan_next_map(parts, parts, nodes, [], nodes, m, opts,
+                          backend="tpu")
+    no_hard_violations(m1, m, set(nodes))
+
+    # Random removal delta.
+    k = int(rng.integers(0, max(N // 4, 1)))
+    removed = list(rng.choice(nodes, k, replace=False)) if k else []
+    m2, _ = plan_next_map(m1, m1, nodes, removed, [], m, opts, backend="tpu")
+    survivors = set(nodes) - set(removed)
+    no_hard_violations(m2, m, survivors)
+    if len(survivors) > R:  # replicas feasible
+        for p in m2.values():
+            assert len(p.nodes_by_state["primary"]) == 1
