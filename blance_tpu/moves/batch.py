@@ -37,28 +37,12 @@ OP_NAMES = ["add", "del", "promote", "demote"]
 _OP_ADD, _OP_DEL, _OP_PROMOTE, _OP_DEMOTE = 0, 1, 2, 3
 
 
-def _state_of(assign: jnp.ndarray, n: int) -> jnp.ndarray:
-    """[P, S, R] slots -> [P, N] state index holding each node, -1 if none.
-
-    If a node somehow appears in several states, the highest-priority
-    (lowest index) wins, matching the reference's superior-first scans.
-    """
-    p, s, _r = assign.shape
-    out = jnp.full((p, n), jnp.int32(s))
-    # Iterate states inferior-first so superior states overwrite.
-    for si in range(s - 1, -1, -1):
-        ids = assign[:, si, :]
-        safe = jnp.where(ids >= 0, ids, n)
-        out = out.at[jnp.arange(p)[:, None], safe].min(
-            jnp.full_like(ids, si), mode="drop")
-    return jnp.where(out == s, -1, out).astype(jnp.int32)
-
-
-@partial(jax.jit, static_argnames=("n", "favor_min_nodes"))
+@partial(jax.jit, static_argnames=("favor_min_nodes",))
 def diff_assignments(
     beg: jnp.ndarray,  # [P, S, R] int32 node ids
     end: jnp.ndarray,  # [P, S, R] int32 node ids
-    n: int,  # node count
+    n: int = 0,  # unused, kept for API compatibility (NOT static: old
+    #              callers passing varying node counts must not retrace)
     favor_min_nodes: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Diff two dense assignments into ordered per-partition op lists.
@@ -69,8 +53,26 @@ def diff_assignments(
     p, s, r = beg.shape
     L = 2 * s * r
 
-    beg_state = _state_of(beg, n)  # [P, N]
-    end_state = _state_of(end, n)
+    # State of each flat slot position (si-major), and each side's state
+    # for every entry of the other side, by all-pairs compare over the
+    # tiny SR axis (no [P, N] scratch, no node-count specialization).
+    bflat = beg.reshape(p, s * r)
+    eflat = end.reshape(p, s * r)
+    pos_state = (jnp.arange(s * r, dtype=jnp.int32) // r)[None, :]
+
+    def lookup(entries, other):
+        """State holding each entry's node on the other side, -1 if absent
+        (superior/lowest state wins on duplicates, like the reference's
+        superior-first scans)."""
+        match = (entries[:, :, None] == other[:, None, :]) & \
+            (entries >= 0)[:, :, None]
+        st = jnp.where(match, jnp.broadcast_to(pos_state[:, None, :],
+                                               match.shape), s)
+        found = jnp.min(st, axis=2)
+        return jnp.where(found == s, -1, found).astype(jnp.int32)
+
+    beg_state_of_end = lookup(eflat, bflat)  # [P, SR]
+    end_state_of_beg = lookup(bflat, eflat)  # [P, SR]
 
     def op_and_key(b, e):
         """Op code + emission key for one (beg_state, end_state) pair."""
@@ -104,14 +106,16 @@ def diff_assignments(
     entries_op = []
     entries_key = []
 
-    def add_entries(slots, side_is_end):
+    def add_entries(slots_flat, own_state_of_entry, other_state_of_entry,
+                    side_is_end):
         for si in range(s):
             for ri in range(r):
-                node = slots[:, si, ri]
+                fi = si * r + ri
+                node = slots_flat[:, fi]
                 valid = node >= 0
-                safe = jnp.clip(node, 0, n - 1)
-                b = jnp.where(valid, beg_state[jnp.arange(p), safe], -1)
-                e = jnp.where(valid, end_state[jnp.arange(p), safe], -1)
+                own = jnp.where(valid, own_state_of_entry[:, fi], -1)
+                other = jnp.where(valid, other_state_of_entry[:, fi], -1)
+                b, e = (other, own) if side_is_end else (own, other)
                 op, key = op_and_key(b, e)
                 if side_is_end:
                     keep = valid & (op >= 0) & (op != _OP_DEL)
@@ -125,8 +129,9 @@ def diff_assignments(
                 entries_op.append(jnp.where(keep, op, -1))
                 entries_key.append(full_key)
 
-    add_entries(end, True)
-    add_entries(beg, False)
+    own_end = jnp.broadcast_to(pos_state, (p, s * r))
+    add_entries(eflat, own_end, beg_state_of_end, True)
+    add_entries(bflat, own_end, end_state_of_beg, False)
 
     nodes = jnp.stack(entries_node, axis=1)  # [P, 2*S*R]
     states = jnp.stack(entries_state, axis=1)
@@ -201,13 +206,39 @@ def calc_all_moves(
     if P == 0 or not nodes:
         return {name: [] for name in names}
 
+    # Pad P to the next power of two so repeated diffs of different-sized
+    # maps hit the jit cache (padding rows are all -1 -> zero ops).
+    p_pad = 1 << max(P - 1, 0).bit_length()
+    if p_pad != P:
+        pad = np.full((p_pad - P,) + beg.shape[1:], -1, np.int32)
+        beg = np.concatenate([beg, pad])
+        end = np.concatenate([end, pad])
+
     d_nodes, d_states, d_ops = diff_assignments(
-        jnp.asarray(beg), jnp.asarray(end), len(nodes), favor_min_nodes)
-    d_nodes = np.asarray(d_nodes)
-    d_states = np.asarray(d_states)
-    d_ops = np.asarray(d_ops)
+        jnp.asarray(beg), jnp.asarray(end), favor_min_nodes=favor_min_nodes)
+    d_nodes = np.asarray(d_nodes)[:P]
+    d_states = np.asarray(d_states)[:P]
+    d_ops = np.asarray(d_ops)[:P]
 
     from .calc import calc_partition_moves
+
+    # Materialize ops flat: valid entries sort to the front of each row
+    # (invalid keys are 2^30), so row pi's moves are its first counts[pi]
+    # flat entries.  One pass over the ~total-op count instead of P x L
+    # Python iterations.
+    mask = d_ops >= 0
+    counts = mask.sum(axis=1)
+    flat = mask.reshape(-1)
+    node_names = np.asarray(nodes, dtype=object)[d_nodes.reshape(-1)[flat]]
+    state_arr = np.asarray(states + [""], dtype=object)
+    state_names = state_arr[d_states.reshape(-1)[flat]]  # -1 wraps to ""
+    op_arr = np.asarray(OP_NAMES, dtype=object)
+    op_names = op_arr[d_ops.reshape(-1)[flat]]
+    flat_moves = [NodeStateOp(n_, s_, o_) for n_, s_, o_ in
+                  zip(node_names.tolist(), state_names.tolist(),
+                      op_names.tolist())]
+    offsets = np.zeros(P + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
 
     out: dict[str, list[NodeStateOp]] = {}
     for pi, name in enumerate(names):
@@ -217,14 +248,6 @@ def calc_all_moves(
                 beg_map[name].nodes_by_state if name in beg_map else {},
                 end_map[name].nodes_by_state if name in end_map else {},
                 favor_min_nodes)
-            continue
-        moves = []
-        for li in range(d_nodes.shape[1]):
-            op = int(d_ops[pi, li])
-            if op < 0:
-                continue
-            node = nodes[int(d_nodes[pi, li])]
-            sname = "" if int(d_states[pi, li]) < 0 else states[int(d_states[pi, li])]
-            moves.append(NodeStateOp(node, sname, OP_NAMES[op]))
-        out[name] = moves
+        else:
+            out[name] = flat_moves[offsets[pi]:offsets[pi + 1]]
     return out

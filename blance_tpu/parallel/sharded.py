@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.encode import DenseProblem
 from ..plan.tensor import solve_dense_converged
 
-__all__ = ["make_mesh", "solve_dense_sharded", "pad_partitions"]
+__all__ = ["make_mesh", "make_hybrid_mesh", "solve_dense_sharded",
+           "pad_partitions"]
 
 PARTITION_AXIS = "parts"
 
@@ -42,6 +43,30 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+
+
+def make_hybrid_mesh() -> Mesh:
+    """Multi-slice (multi-host) 1-D mesh, DCN-aware.
+
+    The solver's only cross-shard traffic is per-node [N] psums, so a 1-D
+    partition axis works across slices — but the DEVICE ORDER matters:
+    XLA lowers a psum over a flat axis hierarchically when devices that
+    share ICI are contiguous in the mesh, keeping the heavy intra-slice
+    hops on ICI and only one reduced copy per slice on DCN.  This helper
+    orders devices slice-major (via mesh_utils when several slices are
+    visible) to guarantee that contiguity; on a single slice it is
+    equivalent to :func:`make_mesh`.
+    """
+    devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (len(devices) // n_slices,), (n_slices,), devices=devices,
+            allow_split_physical_axes=True)
+        return Mesh(dev_array.reshape(-1), (PARTITION_AXIS,))
+    return make_mesh()
 
 
 def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:

@@ -35,12 +35,14 @@ def plan_next_map(
     model: Optional[PartitionModel] = None,
     opts: Optional[PlanOptions] = None,
     backend: str = "greedy",
+    timer=None,
 ) -> tuple[PartitionMap, dict[str, list[str]]]:
     """Compute the next balanced partition map.
 
     Returns (next_map, warnings) where warnings is keyed by partition name
     (constraint shortfalls degrade to warnings, never errors — reference
-    plan.go:231-235).
+    plan.go:231-235).  ``timer`` (utils.trace.PhaseTimer) attributes
+    wall-clock to encode / solve / decode on the tpu backend.
     """
     if model is None:
         raise ValueError("model is required")
@@ -65,7 +67,7 @@ def plan_next_map(
 
         return plan_next_map_tpu(
             prev_map, partitions_to_assign, nodes_all,
-            nodes_to_remove, nodes_to_add, model, opts)
+            nodes_to_remove, nodes_to_add, model, opts, timer=timer)
     raise ValueError(f"unknown backend: {backend!r}")
 
 

@@ -236,7 +236,7 @@ class PlannerSession:
         d_nodes, d_states, d_ops = diff_assignments(
             jnp.asarray(widen(self.current)),
             jnp.asarray(widen(self.proposed)),
-            self._problem.N, favor_min_nodes)
+            favor_min_nodes=favor_min_nodes)
         return np.asarray(d_nodes), np.asarray(d_states), np.asarray(d_ops)
 
     def apply(self) -> None:

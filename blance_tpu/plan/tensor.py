@@ -163,8 +163,8 @@ def _pin_prev_holders(
     cap: jnp.ndarray,  # [N] GLOBAL capacity for this state
     slack: jnp.ndarray,  # [P] per-holder capacity tolerance (stickiness)
     axis_name: Optional[str],
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Capacity-capped warm start: returns (pinned[P] bool, used[N]).
+) -> jnp.ndarray:
+    """Capacity-capped warm start: returns pinned[P] bool.
 
     Eligible previous holders keep their node up to its capacity plus the
     holder's stickiness ``slack``, in partition order (deterministic).  The
@@ -217,10 +217,7 @@ def _pin_prev_holders(
         keep_s = _segment_accept(node_s, ok_s, w_s, cap_here)
         return jnp.zeros(p, jnp.bool_).at[perm].set(keep_s)
 
-    pinned = lax.cond(jnp.any(node_w > cap), trim, keep_all, None)
-    used = jnp.zeros(n, jnp.float32).at[safe].add(
-        jnp.where(pinned, pweights, 0.0), mode="drop")
-    return pinned, used
+    return lax.cond(jnp.any(node_w > cap), trim, keep_all, None)
 
 
 def _assign_slot(
@@ -513,7 +510,7 @@ def solve_dense(
             pin_ok_k &= hier[rows, safe_k] < \
                 (hier_floor[:, None] + _RULE_TIER * 0.5)
         state_cap = jnp.ceil(k * total_w * cap_share)
-        pins_flat, _ = _pin_prev_holders(
+        pins_flat = _pin_prev_holders(
             prev_k.reshape(-1),
             pin_ok_k.reshape(-1),
             jnp.repeat(pweights, kk),
@@ -665,15 +662,23 @@ def plan_next_map_tpu(
     nodes_to_add: Optional[list[str]],
     model: PartitionModel,
     opts: Optional[PlanOptions] = None,
+    timer=None,
 ) -> tuple[PartitionMap, dict[str, list[str]]]:
     """TPU-backed equivalent of plan_next_map_greedy: one global batched
     solve instead of a sequential pass.  Same inputs/outputs; nodes_to_add
-    is implicit (fresh nodes simply have zero counts, which attracts load)."""
+    is implicit (fresh nodes simply have zero counts, which attracts load).
+    ``timer`` (utils.trace.PhaseTimer) attributes wall-clock to
+    encode / solve / decode when provided."""
+    from ..utils.trace import PhaseTimer
+
     opts = opts or PlanOptions()
     del nodes_to_add
+    timer = timer if timer is not None else PhaseTimer()
 
-    problem = encode_problem(
-        prev_map, partitions_to_assign, nodes_all, nodes_to_remove, model, opts)
+    with timer.phase("encode"):
+        problem = encode_problem(
+            prev_map, partitions_to_assign, nodes_all, nodes_to_remove,
+            model, opts)
     if problem.P == 0 or problem.N == 0 or problem.S == 0:
         return decode_assignment(
             problem,
@@ -684,17 +689,19 @@ def plan_next_map_tpu(
         tuple(problem.rules.get(si, ())) for si in range(problem.S))
     constraints = tuple(int(c) for c in problem.constraints)
 
-    assign = solve_dense_converged(
-        jnp.asarray(problem.prev),
-        jnp.asarray(problem.partition_weights),
-        jnp.asarray(problem.node_weights),
-        jnp.asarray(problem.valid_node),
-        jnp.asarray(problem.stickiness),
-        jnp.asarray(problem.gids),
-        jnp.asarray(problem.gid_valid),
-        constraints,
-        rules,
-        max_iterations=max(int(opts.max_iterations), 1),
-    )
-    return decode_assignment(
-        problem, np.asarray(assign), partitions_to_assign, nodes_to_remove)
+    with timer.phase("solve"):
+        assign = np.asarray(solve_dense_converged(
+            jnp.asarray(problem.prev),
+            jnp.asarray(problem.partition_weights),
+            jnp.asarray(problem.node_weights),
+            jnp.asarray(problem.valid_node),
+            jnp.asarray(problem.stickiness),
+            jnp.asarray(problem.gids),
+            jnp.asarray(problem.gid_valid),
+            constraints,
+            rules,
+            max_iterations=max(int(opts.max_iterations), 1),
+        ))
+    with timer.phase("decode"):
+        return decode_assignment(
+            problem, assign, partitions_to_assign, nodes_to_remove)

@@ -88,3 +88,13 @@ def test_sharded_growth_migrates_pinned_load():
     report = check_assignment(prob2, a2)
     assert report == {"duplicates": 0, "on_removed_nodes": 0,
                       "unfilled_feasible_slots": 0}
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    """On hosts without multiple slices, the hybrid helper degrades to the
+    plain mesh (virtual CPU devices report no slice_index)."""
+    from blance_tpu.parallel.sharded import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("parts",)
