@@ -106,14 +106,14 @@ def diff_assignments(
     entries_op = []
     entries_key = []
 
-    def add_entries(slots_flat, own_state_of_entry, other_state_of_entry,
-                    side_is_end):
+    def add_entries(slots_flat, other_state_of_entry, side_is_end):
         for si in range(s):
             for ri in range(r):
                 fi = si * r + ri
                 node = slots_flat[:, fi]
                 valid = node >= 0
-                own = jnp.where(valid, own_state_of_entry[:, fi], -1)
+                # An entry's own-side state is just its slot's state index.
+                own = jnp.where(valid, jnp.int32(si), -1)
                 other = jnp.where(valid, other_state_of_entry[:, fi], -1)
                 b, e = (other, own) if side_is_end else (own, other)
                 op, key = op_and_key(b, e)
@@ -129,9 +129,8 @@ def diff_assignments(
                 entries_op.append(jnp.where(keep, op, -1))
                 entries_key.append(full_key)
 
-    own_end = jnp.broadcast_to(pos_state, (p, s * r))
-    add_entries(eflat, own_end, beg_state_of_end, True)
-    add_entries(bflat, own_end, end_state_of_beg, False)
+    add_entries(eflat, beg_state_of_end, True)
+    add_entries(bflat, end_state_of_beg, False)
 
     nodes = jnp.stack(entries_node, axis=1)  # [P, 2*S*R]
     states = jnp.stack(entries_state, axis=1)

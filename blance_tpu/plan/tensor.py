@@ -526,26 +526,6 @@ def solve_dense(
             True, mode="drop")
 
         for ri in range(k):
-            balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
-            score = balance / w_div[None, :]
-            # Same-ordinal alignment: slot ri mildly prefers prev slot ri's
-            # node (above jitter, below every real term), so sticky bids
-            # don't scramble ordinals and leftovers stay spread.
-            if ri < r_max:
-                score = score - 0.01 * _membership(prev[:, si, ri:ri + 1], n)
-            score = score + jnp.maximum(
-                neg_boost[None, :],
-                jnp.where(neg_boost[None, :] > 0,
-                          stickiness[:, si][:, None], 0.0))
-            score = score - sticky_bonus
-            score = score + hier
-            score = score + _INF * (taken | ~valid[None, :])
-
-            # Exact ceil capacity: the binding rail that yields tight
-            # balance; exclusivity stragglers rebid under the in-slot price
-            # and, in the worst case, the force step places them.
-            cap = _shard_capacity(jnp.ceil(total_w * cap_share), axis_name)
-
             # This ordinal's share of the state-level pins; only displaced
             # or over-capacity copies enter the auction below.
             if ri < kk:
@@ -556,9 +536,50 @@ def solve_dense(
                 _drop_empty(init_assign, n)].add(
                 jnp.where(init_assign >= 0, pweights, 0.0), mode="drop")
 
-            slot_assign, used = _assign_slot(
-                score, pweights, cap, 1.0 / w_div, jitter_scale, axis_name,
-                init_assign=init_assign, init_used=pin_used)
+            all_pinned = jnp.all(init_assign >= 0)
+            if axis_name:
+                all_pinned = lax.psum(
+                    (~all_pinned).astype(jnp.int32), axis_name) == 0
+
+            def run_auction(_, *, ri=ri):
+                """Score + auction + force for this slot — the expensive
+                path, skipped entirely when every copy pinned (converged
+                passes of solve_dense_converged land here for every slot,
+                so the confirming pass never touches a [P, N] tensor)."""
+                balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
+                score = balance / w_div[None, :]
+                # Same-ordinal alignment: slot ri mildly prefers prev slot
+                # ri's node (above jitter, below every real term), so
+                # sticky bids don't scramble ordinals and leftovers stay
+                # spread.
+                if ri < r_max:
+                    score = score - 0.01 * _membership(
+                        prev[:, si, ri:ri + 1], n)
+                score = score + jnp.maximum(
+                    neg_boost[None, :],
+                    jnp.where(neg_boost[None, :] > 0,
+                              stickiness[:, si][:, None], 0.0))
+                score = score - sticky_bonus
+                score = score + hier
+                score = score + _INF * (taken | ~valid[None, :])
+
+                # Exact ceil capacity: the binding rail that yields tight
+                # balance; exclusivity stragglers rebid under the in-slot
+                # price and, in the worst case, the force step places them.
+                cap = _shard_capacity(
+                    jnp.ceil(total_w * cap_share), axis_name)
+                return _assign_slot(
+                    score, pweights, cap, 1.0 / w_div, jitter_scale,
+                    axis_name, init_assign=init_assign, init_used=pin_used)
+
+            def keep_pins(_):
+                return init_assign, pin_used
+
+            # NB: no collectives run inside either branch (_assign_slot is
+            # shard-local by design), so a cond on the globally-agreed
+            # all_pinned flag is safe under shard_map.
+            slot_assign, used = lax.cond(
+                all_pinned, keep_pins, run_auction, None)
             used = _psum(used, axis_name)  # global per-node accepted weight
 
             assign = assign.at[:, si, ri].set(slot_assign)
