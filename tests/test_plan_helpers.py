@@ -1,0 +1,99 @@
+"""Ports of the reference's planner-helper unit tables
+(plan_test.go:21-304): flatten, removal, state-name sorting, weighted
+state-node counting, and deep-copy independence."""
+
+from blance_tpu import (
+    Partition,
+    PartitionModelState,
+    copy_partition_map,
+    count_state_nodes,
+    flatten_nodes_by_state,
+    sort_state_names,
+)
+from blance_tpu.plan.greedy import _remove_nodes_from_nodes_by_state
+
+
+def test_flatten_nodes_by_state():
+    # plan_test.go:21-50 — state-priority iteration order, empties skipped.
+    cases = [
+        ({}, []),
+        ({"primary": []}, []),
+        ({"primary": ["a"]}, ["a"]),
+        ({"primary": ["a", "b"]}, ["a", "b"]),
+        ({"primary": ["a", "b"], "replica": ["c"]}, ["a", "b", "c"]),
+        ({"primary": ["a", "b"], "replica": []}, ["a", "b"]),
+    ]
+    for nbs, exp in cases:
+        assert flatten_nodes_by_state(nbs) == exp, nbs
+
+
+def test_remove_nodes_from_nodes_by_state():
+    # plan_test.go:52-117 — order-preserving, per-state, no dedupe.
+    cases = [
+        ({"primary": ["a", "b"]}, ["a", "b"], {"primary": []}),
+        ({"primary": ["a", "b"]}, ["b", "c"], {"primary": ["a"]}),
+        ({"primary": ["a", "b"]}, ["a", "c"], {"primary": ["b"]}),
+        ({"primary": ["a", "b"]}, [], {"primary": ["a", "b"]}),
+        ({"primary": ["a", "b"], "replica": ["c"]}, [],
+         {"primary": ["a", "b"], "replica": ["c"]}),
+        ({"primary": ["a", "b"], "replica": ["c"]}, ["a"],
+         {"primary": ["b"], "replica": ["c"]}),
+        ({"primary": ["a", "b"], "replica": ["c"]}, ["a", "c"],
+         {"primary": ["b"], "replica": []}),
+    ]
+    for nbs, remove, exp in cases:
+        assert _remove_nodes_from_nodes_by_state(nbs, remove) == exp, \
+            (nbs, remove)
+
+
+def test_sort_state_names():
+    # plan_test.go:118-181 — priority ascending, then name; unknown states
+    # sort by name at default priority.
+    model = {
+        "primary": PartitionModelState(priority=0),
+        "replica": PartitionModelState(priority=1),
+    }
+    assert sort_state_names({}) == []
+    assert sort_state_names(model) == ["primary", "replica"]
+    # Unknown names tie at priority 0 and order alphabetically; the
+    # reference's sorter leaves unknown-vs-known ordering to name compare
+    # within equal priority.
+    mixed = {
+        "primary": PartitionModelState(priority=0),
+        "a": PartitionModelState(priority=0),
+    }
+    assert sort_state_names(mixed) == ["a", "primary"]
+
+
+def test_count_state_nodes():
+    # plan_test.go:182-241 — per-state weighted node histogram.
+    pm = {
+        "0": Partition("0", {"primary": ["a"], "replica": ["b", "c"]}),
+        "1": Partition("1", {"primary": ["b"], "replica": ["c"]}),
+    }
+    assert count_state_nodes(pm, None) == {
+        "primary": {"a": 1, "b": 1},
+        "replica": {"b": 1, "c": 2},
+    }
+    pm2 = {
+        "0": Partition("0", {"replica": ["b", "c"]}),
+        "1": Partition("1", {"primary": ["b"], "replica": ["c"]}),
+    }
+    assert count_state_nodes(pm2, None) == {
+        "primary": {"b": 1},
+        "replica": {"b": 1, "c": 2},
+    }
+    # Partition weights scale the counts (plan.go:374-399).
+    assert count_state_nodes(pm2, {"0": 3}) == {
+        "primary": {"b": 1},
+        "replica": {"b": 3, "c": 4},
+    }
+
+
+def test_copy_partition_map_is_deep():
+    # plan_test.go:242-304 — mutations of the copy never leak back.
+    src = {"0": Partition("0", {"primary": ["a"], "replica": ["b"]})}
+    cp = copy_partition_map(src)
+    cp["0"].nodes_by_state["primary"].append("z")
+    cp["0"].nodes_by_state["extra"] = ["y"]
+    assert src["0"].nodes_by_state == {"primary": ["a"], "replica": ["b"]}
