@@ -89,28 +89,42 @@ def _membership(ids: jnp.ndarray, n: int) -> jnp.ndarray:
 
 
 def _hier_penalty(
-    anchor: jnp.ndarray,  # [P] node id or -1
+    anchors: jnp.ndarray,  # [P, A] node ids, -1 = absent anchor
     gids: jnp.ndarray,  # [L, N]
     gid_valid: jnp.ndarray,  # [L, N]
     rules: tuple,  # ((include_level, exclude_level), ...)
 ) -> jnp.ndarray:
-    """Tiered rule penalty [P, N]: first-satisfied rule index sets the tier;
-    satisfying none costs _RULE_MISS.  Unsatisfiable rules penalize every
-    node equally, which leaves the argmin order flat — the reference's
-    fall-back-to-flat-candidates behavior (plan.go:214-220)."""
-    p = anchor.shape[0]
+    """Tiered rule penalty [P, N] anchored on EVERY prior pick at once.
+
+    The reference anchors each hierarchy pick on the primary *plus all
+    nodes picked so far for the partition* (the intersection at
+    plan.go:185-191,738-753), which is what makes two replicas under a
+    rule like (include 2, exclude 1) land on two *different* racks — not
+    merely racks different from the primary's.  A rule is satisfied by
+    node n iff, for every present anchor a: n shares a's include-level
+    ancestor and NOT a's exclude-level ancestor.  First-satisfied rule
+    index sets the tier; satisfying none costs _RULE_MISS.  Unsatisfiable
+    rules penalize every node equally, which leaves the argmin order
+    flat — the reference's fall-back-to-flat-candidates behavior
+    (plan.go:214-220).  A ~ 1 + constraints, so the anchor loop unrolls
+    into a handful of [P, N] comparisons that XLA fuses into the score
+    expression — no [P, N, A] tensor materializes."""
+    p, a_width = anchors.shape
     n = gids.shape[1]
-    anchor_ok = anchor >= 0
-    a = jnp.maximum(anchor, 0)
+    any_anchor = jnp.any(anchors >= 0, axis=1)
     pen = jnp.full((p, n), _RULE_MISS, jnp.float32)
     for idx, (inc, exc) in enumerate(rules):
-        inc_same = (gids[inc][a][:, None] == gids[inc][None, :]) & \
-            gid_valid[inc][a][:, None]
-        exc_same = (gids[exc][a][:, None] == gids[exc][None, :]) & \
-            gid_valid[exc][a][:, None]
-        sat = inc_same & ~exc_same
+        sat = jnp.ones((p, n), jnp.bool_)
+        for ai in range(a_width):
+            anc = anchors[:, ai]
+            aa = jnp.maximum(anc, 0)
+            inc_same = (gids[inc][aa][:, None] == gids[inc][None, :]) & \
+                gid_valid[inc][aa][:, None]
+            exc_same = (gids[exc][aa][:, None] == gids[exc][None, :]) & \
+                gid_valid[exc][aa][:, None]
+            sat &= jnp.where((anc >= 0)[:, None], inc_same & ~exc_same, True)
         pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
-    return jnp.where(anchor_ok[:, None], pen, 0.0)
+    return jnp.where(any_anchor[:, None], pen, 0.0)
 
 
 def _psum(x, axis_name):
@@ -473,14 +487,6 @@ def solve_dense(
 
         anchor = jnp.where(assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor) \
             if si > 0 else top_anchor
-        hier = _hier_penalty(anchor, gids, gid_valid, rules[si]) \
-            if rules[si] else 0.0
-        # Best attainable rule tier per partition (over surviving nodes):
-        # pins must not freeze a fallback-tier placement when a preferred
-        # tier is reachable — the 1e4 tier gap outweighs stickiness in the
-        # auction, and pinning must not override that.
-        hier_floor = jnp.min(jnp.where(valid[None, :], hier, _INF), axis=1) \
-            if rules[si] else None
 
         # Warm start, decided per STATE across all k ordinals: a previous
         # holder whose node survives, isn't taken by a higher-priority
@@ -506,9 +512,36 @@ def solve_dense(
             for i in range(j):
                 dup |= (prev_k[:, j] == prev_k[:, i]) & (prev_k[:, j] >= 0)
             pin_ok_k = pin_ok_k.at[:, j].set(pin_ok_k[:, j] & ~dup)
+        # Rule anchors for this state: column 0 is the primary anchor;
+        # column 1+j is ordinal j's node once pinned/assigned.  Grown
+        # ordinal-by-ordinal so every pick's penalty sees all prior picks
+        # (reference plan.go:185-191) — this is what spreads replica pairs
+        # across racks, not just replicas away from the primary.
+        anchors = (jnp.full((p, 1 + k), -1, jnp.int32).at[:, 0].set(anchor)
+                   if rules[si] else None)
         if rules[si]:
-            pin_ok_k &= hier[rows, safe_k] < \
-                (hier_floor[:, None] + _RULE_TIER * 0.5)
+            # Pin eligibility, decided sequentially: a pin must sit at the
+            # best attainable rule tier GIVEN the copies already kept
+            # (primary + earlier ordinals' pin candidates) — the 1e4 tier
+            # gap outweighs stickiness in the auction, and pinning must not
+            # override that; nor may two surviving replicas stay co-racked.
+            # Deliberately pre-capacity-trim: if the earlier pin is later
+            # trimmed, a co-racked later ordinal loses its pin too — but the
+            # anchors re-seed below drops the trimmed rack, and stickiness
+            # steers the displaced copy back to its own node in the auction,
+            # so the corner costs at most one extra converge pass, never a
+            # rule violation.
+            rows1 = jnp.arange(p)
+            for j in range(kk):
+                hier_j = _hier_penalty(
+                    anchors[:, :1 + j], gids, gid_valid, rules[si])
+                floor_j = jnp.min(
+                    jnp.where(valid[None, :], hier_j, _INF), axis=1)
+                ok_j = pin_ok_k[:, j] & (
+                    hier_j[rows1, safe_k[:, j]] < floor_j + _RULE_TIER * 0.5)
+                pin_ok_k = pin_ok_k.at[:, j].set(ok_j)
+                anchors = anchors.at[:, 1 + j].set(
+                    jnp.where(ok_j, prev_k[:, j], -1))
         state_cap = jnp.ceil(k * total_w * cap_share)
         pins_flat = _pin_prev_holders(
             prev_k.reshape(-1),
@@ -524,6 +557,16 @@ def solve_dense(
         # land on the node slot-1 keeps pinned.
         taken = taken.at[rows, jnp.where(pins, safe_k, n)].set(
             True, mode="drop")
+        if rules[si]:
+            # Re-seed anchors from the capacity-trimmed pins: a trimmed pin
+            # must not keep excluding its rack from the auction, while a
+            # surviving pin must exclude its rack from EVERY ordinal's
+            # auction (including earlier ones — a displaced slot-0 copy may
+            # not land in the rack slot-1 keeps pinned).
+            anchors = jnp.full((p, 1 + k), -1, jnp.int32).at[:, 0].set(anchor)
+            for j in range(kk):
+                anchors = anchors.at[:, 1 + j].set(
+                    jnp.where(pins[:, j], prev_k[:, j], -1))
 
         for ri in range(k):
             # This ordinal's share of the state-level pins; only displaced
@@ -541,7 +584,7 @@ def solve_dense(
                 all_pinned = lax.psum(
                     (~all_pinned).astype(jnp.int32), axis_name) == 0
 
-            def run_auction(_, *, ri=ri):
+            def run_auction(_, *, ri=ri, anchors=anchors):
                 """Score + auction + force for this slot — the expensive
                 path, skipped entirely when every copy pinned (converged
                 passes of solve_dense_converged land here for every slot,
@@ -560,7 +603,16 @@ def solve_dense(
                     jnp.where(neg_boost[None, :] > 0,
                               stickiness[:, si][:, None], 0.0))
                 score = score - sticky_bonus
-                score = score + hier
+                # Per-slot rule penalty: anchored on the primary, every
+                # pinned ordinal, and every slot already assigned this
+                # state — so consecutive replicas spread across exclusion
+                # groups.  Built HERE (not outside the cond — lax.cond
+                # evaluates closure captures eagerly) so fully-pinned
+                # converged passes never materialize a [P, N] tensor; the
+                # branch captures only the small [P, 1+k] anchors.
+                if rules[si]:
+                    score = score + _hier_penalty(
+                        anchors, gids, gid_valid, rules[si])
                 score = score + _INF * (taken | ~valid[None, :])
 
                 # Exact ceil capacity: the binding rail that yields tight
@@ -586,6 +638,8 @@ def solve_dense(
             total = total + used
             safe_slot = _drop_empty(slot_assign, n)
             taken = taken.at[jnp.arange(p), safe_slot].set(True, mode="drop")
+            if rules[si]:
+                anchors = anchors.at[:, 1 + ri].set(slot_assign)
 
     return assign
 

@@ -129,6 +129,57 @@ def test_hierarchy_other_rack_rule():
                 f"{p.name}: replica {rep} same rack as primary {primary}"
 
 
+@pytest.mark.parametrize("backend", ["greedy", "tpu"])
+def test_hierarchy_replica_pair_anti_affinity(backend):
+    """Two replicas under (include 2, exclude 1) must land on two DIFFERENT
+    racks — each pick anchors on the primary plus all picks so far
+    (reference plan.go:185-191), not just the primary.  Round-1 regression:
+    the tpu backend co-racked 5/12 replica pairs here."""
+    nodes = [f"n{i}" for i in range(9)]
+    hierarchy = {n: f"r{i % 3}" for i, n in enumerate(nodes)}
+    hierarchy.update({f"r{i}": "z0" for i in range(3)})
+    rack = {n: hierarchy[n] for n in nodes}
+    rules = {"replica": [HierarchyRule(include_level=2, exclude_level=1)]}
+    result, warnings = plan_next_map(
+        empty_parts(12), empty_parts(12), nodes, [], nodes, M_1P_2R,
+        PlanOptions(node_hierarchy=hierarchy, hierarchy_rules=rules),
+        backend=backend)
+    assert not warnings
+    no_hard_violations(result, M_1P_2R, set(nodes))
+    for p in result.values():
+        primary = p.nodes_by_state["primary"][0]
+        reps = p.nodes_by_state["replica"]
+        assert len(reps) == 2, (p.name, reps)
+        racks = [rack[primary]] + [rack[r] for r in reps]
+        assert len(set(racks)) == 3, \
+            f"{p.name}: co-racked copies {p.nodes_by_state} ({racks})"
+
+
+def test_hierarchy_replica_pair_unsticks_from_co_rack():
+    """A prev map with both replicas co-racked must be repaired, not
+    pinned in place: stickiness may keep ONE replica on its rack, the
+    other must move to the free rack."""
+    nodes = [f"n{i}" for i in range(9)]
+    hierarchy = {n: f"r{i % 3}" for i, n in enumerate(nodes)}
+    hierarchy.update({f"r{i}": "z0" for i in range(3)})
+    rack = {n: hierarchy[n] for n in nodes}
+    rules = {"replica": [HierarchyRule(include_level=2, exclude_level=1)]}
+    # Primary on rack 0; both replicas on rack 1 (n1, n4).
+    prev = {str(i): Partition(str(i), {"primary": ["n0"],
+                                       "replica": ["n1", "n4"]})
+            for i in range(6)}
+    result, warnings = plan_next_map(
+        prev, prev, nodes, [], [], M_1P_2R,
+        PlanOptions(node_hierarchy=hierarchy, hierarchy_rules=rules),
+        backend="tpu")
+    assert not warnings
+    no_hard_violations(result, M_1P_2R, set(nodes))
+    for p in result.values():
+        racks = [rack[p.nodes_by_state["primary"][0]]] + \
+            [rack[r] for r in p.nodes_by_state["replica"]]
+        assert len(set(racks)) == 3, (p.name, p.nodes_by_state, racks)
+
+
 def test_hierarchy_rule_unmeetable_falls_back_flat():
     # Single rack: other-rack rule unmeetable -> still assigns (flat).
     nodes = ["a", "b", "c"]
