@@ -124,7 +124,10 @@ def encode_problem(
             filled = np.empty((P, S, r_max), dtype=np.int32)
             native.fill_prev(filled, P, S, r_max, partitions, prev_map,
                              partitions_to_assign, state_index, node_index)
-        except TypeError:
+        except (TypeError, AttributeError):
+            # AttributeError: a None/falsy entry in prev_map reaches
+            # .nodes_by_state in C; the Python loop below tolerates it
+            # via the `or partitions_to_assign[...]` fallthrough.
             filled = None
             r_max = int(constraints.max()) if len(constraints) else 0
     if filled is None:
@@ -289,7 +292,7 @@ def decode_assignment(
             next_map = native.build_map(
                 Partition, problem.partitions, mod_names, rows_per_state,
                 partitions_to_assign, solved_states, set(removed))
-        except TypeError:
+        except (TypeError, AttributeError):
             next_map = None  # structural surprise: pure-Python fallback
     if next_map is None:
         next_map = {}

@@ -156,12 +156,23 @@ def calc_all_moves(
     (cross-checked in tests); use this for 100k-partition rebalances where
     the host loop is the bottleneck.
     """
-    from ..plan.greedy import sort_state_names
+    from ..plan.greedy import sort_state_names, sorted_by_partition_name
+
+    if beg_map.keys() != end_map.keys():
+        # The host path (orchestrate_moves) raises KeyError on a partition
+        # missing from end_map; silently emitting del-everything here would
+        # be a behavior divergence between the two modes.
+        missing = beg_map.keys() ^ end_map.keys()
+        raise KeyError(
+            f"beg_map/end_map partition sets differ: {sorted(missing)[:5]}")
 
     states = sort_state_names(model)
     state_index = {sname: i for i, sname in enumerate(states)}
 
-    names = sorted(beg_map.keys())
+    # Planner iteration order (zero-padded numeric names), so device-diff
+    # op logs replay in the same partition order the planner used — not
+    # plain lexicographic (cf. orchestrate.go:264-287 trace reproducibility).
+    names = sorted_by_partition_name(beg_map.keys())
     nodes: list[str] = []
     node_index: dict[str, int] = {}
 
@@ -188,9 +199,7 @@ def calc_all_moves(
     irregular: set[str] = set()
     for pi, name in enumerate(names):
         for arr, m in ((beg, beg_map), (end, end_map)):
-            partition = m.get(name)
-            if partition is None:
-                continue
+            partition = m[name]  # key equality enforced above
             seen_nodes: set[str] = set()
             for sname, ns in partition.nodes_by_state.items():
                 si = state_index.get(sname)
@@ -244,8 +253,8 @@ def calc_all_moves(
         if name in irregular:
             out[name] = calc_partition_moves(
                 states,
-                beg_map[name].nodes_by_state if name in beg_map else {},
-                end_map[name].nodes_by_state if name in end_map else {},
+                beg_map[name].nodes_by_state,
+                end_map[name].nodes_by_state,
                 favor_min_nodes)
         else:
             out[name] = flat_moves[offsets[pi]:offsets[pi + 1]]

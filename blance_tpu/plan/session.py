@@ -83,7 +83,11 @@ class PlannerSession:
 
     @property
     def problem(self):
-        """The encoded statics (DenseProblem); prev reflects ``current``."""
+        """The encoded statics (DenseProblem).
+
+        ``problem.prev`` is only the encode-time seed (all -1, or the last
+        load_map snapshot) — it goes stale after add_nodes()/replan()/
+        apply().  ``self.current`` is the authoritative live assignment."""
         return self._problem
 
     # -- cluster membership ----------------------------------------------------

@@ -729,6 +729,28 @@ def check_assignment(
             "unfilled_feasible_slots": shortfall}
 
 
+def _tpu_supported(opts: PlanOptions) -> bool:
+    """Can the batched solver honor these options' placement policy?
+
+    The device score bakes in the default scoring formula plus the cbgt
+    booster shape max(-weight, stickiness); an arbitrary Python
+    ``node_scorer`` or a non-cbgt ``node_score_booster`` cannot run inside
+    the jitted computation (reference contract: plan.go:580,693-697).
+    Negative node weights WITHOUT a booster are also unsupported: the
+    reference ignores them entirely (plan.go:675-684 boosts only when the
+    booster is set), while the device score would pin them."""
+    if opts.node_scorer is not None:
+        return False
+    booster = opts.node_score_booster
+    if booster is not None and \
+            getattr(booster, "__blance_native__", None) != "cbgt":
+        return False
+    if booster is None and opts.node_weights and \
+            any(w < 0 for w in opts.node_weights.values()):
+        return False
+    return True
+
+
 def plan_next_map_tpu(
     prev_map: PartitionMap,
     partitions_to_assign: PartitionMap,
@@ -743,12 +765,25 @@ def plan_next_map_tpu(
     solve instead of a sequential pass.  Same inputs/outputs; nodes_to_add
     is implicit (fresh nodes simply have zero counts, which attracts load).
     ``timer`` (utils.trace.PhaseTimer) attributes wall-clock to
-    encode / solve / decode when provided."""
+    encode / solve / decode when provided.
+
+    Custom placement hooks the device score can't express fall back to the
+    native/greedy exact path — a cbgt-style app keeps its policy even when
+    ``backend="auto"`` routes a large problem here."""
     from ..utils.trace import PhaseTimer
 
     opts = opts or PlanOptions()
-    del nodes_to_add
     timer = timer if timer is not None else PhaseTimer()
+    if not _tpu_supported(opts):
+        from .native import plan_next_map_native  # falls back to greedy
+
+        # The exact path has no encode/solve/decode split; attribute it
+        # all to "solve" so a caller's timer still sees the wall-clock.
+        with timer.phase("solve"):
+            return plan_next_map_native(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, nodes_to_add, model, opts)
+    del nodes_to_add
 
     with timer.phase("encode"):
         problem = encode_problem(

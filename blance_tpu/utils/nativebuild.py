@@ -21,14 +21,29 @@ def compile_cached(source: str, out_path: str, command: list[str]) -> bool:
     ``source`` and ``out_path``).  Returns True when a fresh-enough binary
     is in place; False when the source is missing or the build failed —
     callers fall back to their pure-Python paths.
+
+    The compiler writes to a process-unique temp path in the same
+    directory, published with an atomic os.replace(): concurrent importers
+    only ever dlopen a fully-written shared object (a plain in-place write
+    passes the existence/mtime check the moment the file is created).
     """
     if not os.path.exists(source):
         return False
+    tmp_path = f"{out_path}.{os.getpid()}.tmp"
     try:
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
         if (not os.path.exists(out_path)
                 or os.path.getmtime(out_path) < os.path.getmtime(source)):
-            subprocess.run(command, check=True, capture_output=True)
+            subprocess.run(
+                [tmp_path if c == out_path else c for c in command],
+                check=True, capture_output=True)
+            os.replace(tmp_path, out_path)
         return True
     except (OSError, subprocess.CalledProcessError):
         return False
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass

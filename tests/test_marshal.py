@@ -108,3 +108,16 @@ def test_structural_surprise_falls_back():
     assert problem.prev[0, 0, 0] == 0 and problem.prev[0, 0, 1] == 1
     m, w = enc.decode_assignment(problem, problem.prev, prev, None)
     assert m["p"].nodes_by_state["primary"] == ["n0", "n1"]
+
+
+def test_none_in_prev_map_falls_back():
+    """A None value in prev_map raises AttributeError inside marshal.c;
+    the Python path tolerates it (`prev_map.get(p) or ...` falls through
+    to partitions_to_assign) — the native try block must catch it too."""
+    _with_native(True)
+    model = {"primary": PartitionModelState(0, 1)}
+    parts = {"a": Partition("a", {}), "b": Partition("b", {})}
+    prev = {"a": None, "b": Partition("b", {"primary": ["n0"]})}
+    problem = enc.encode_problem(prev, parts, ["n0", "n1"], None, model,
+                                 PlanOptions())
+    assert problem.prev[0, 0, 0] == -1 and problem.prev[1, 0, 0] == 0

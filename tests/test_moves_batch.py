@@ -73,3 +73,22 @@ def test_batch_diff_empty_and_noop():
     end = {"x": Partition("x", {"primary": ["a"]})}
     assert calc_all_moves(beg, end, M) == {"x": []}
     assert calc_all_moves({}, {}, M) == {}
+
+
+def test_batch_diff_rejects_mismatched_keys():
+    # Host path raises KeyError on a partition missing from end_map; the
+    # batched mode must not silently emit del-everything instead.
+    import pytest
+
+    beg = {"x": Partition("x", {"primary": ["a"]}),
+           "y": Partition("y", {"primary": ["b"]})}
+    end = {"x": Partition("x", {"primary": ["a"]})}
+    with pytest.raises(KeyError):
+        calc_all_moves(beg, end, M)
+
+
+def test_batch_diff_iterates_in_planner_order():
+    # Numeric names replay in planner (zero-padded) order: 2 before 10.
+    beg = {n: Partition(n, {"primary": ["a"]}) for n in ("10", "2")}
+    end = {n: Partition(n, {"primary": ["b"]}) for n in ("10", "2")}
+    assert list(calc_all_moves(beg, end, M)) == ["2", "10"]

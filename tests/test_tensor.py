@@ -195,6 +195,59 @@ def test_hierarchy_rule_unmeetable_falls_back_flat():
         assert len(p.nodes_by_state["replica"]) == 1
 
 
+def test_custom_hooks_fall_back_to_exact(monkeypatch):
+    """A custom node_scorer (or non-cbgt booster) can't run inside the
+    jitted score; tpu/auto must fall back to the exact path and match the
+    greedy golden output instead of silently dropping the policy
+    (reference contract: plan.go:580,693-697)."""
+    from blance_tpu.plan import api as plan_api
+
+    def prefer_c(ctx, node):
+        from blance_tpu.plan.greedy import default_node_score
+        r = default_node_score(ctx, node)
+        return r - 100.0 if node == "c" else r
+
+    nodes = ["a", "b", "c", "d"]
+    parts = empty_parts(16)
+    opts = PlanOptions(node_scorer=prefer_c)
+    golden, gw = plan_next_map(
+        empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts,
+        backend="greedy")
+    # Sanity: the hook actually bit — every primary pinned to c.
+    assert all(p.nodes_by_state["primary"] == ["c"] for p in golden.values())
+
+    # Direct tpu call and an auto call routed above the size threshold.
+    monkeypatch.setattr(plan_api, "_AUTO_TPU_THRESHOLD", 1)
+    for backend in ("tpu", "auto"):
+        got, w = plan_next_map(
+            empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts,
+            backend=backend)
+        assert got == golden, backend
+        assert w == gw, backend
+
+    # Non-cbgt booster likewise falls back and matches greedy.
+    opts2 = PlanOptions(node_weights={"a": -2},
+                        node_score_booster=lambda w, s: -50.0)
+    golden2, _ = plan_next_map(
+        empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts2,
+        backend="greedy")
+    got2, _ = plan_next_map(
+        empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts2,
+        backend="tpu")
+    assert got2 == golden2
+
+    # Negative weight with NO booster: reference ignores it — the device
+    # score would pin it, so this too must take the exact path.
+    opts3 = PlanOptions(node_weights={"a": -2})
+    golden3, _ = plan_next_map(
+        empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts3,
+        backend="greedy")
+    got3, _ = plan_next_map(
+        empty_parts(16), parts, nodes, [], nodes, M_1P_1R, opts3,
+        backend="tpu")
+    assert got3 == golden3
+
+
 def test_too_few_nodes_warns():
     result, warnings = plan_next_map(
         empty_parts(4), empty_parts(4), ["a"], [], ["a"], M_1P_1R,
