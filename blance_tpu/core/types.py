@@ -143,6 +143,14 @@ class PlanOptions:
     # (fixes the reference quirk at plan.go:104-115).
     state_stickiness_standalone: bool = False
 
+    # --- validation ---
+    # Post-solve constraint audit on the batched (tpu) backend: duplicates,
+    # placements on removed nodes, unfilled-but-feasible slots surface as
+    # UserWarnings (the reference degrades to warnings too, plan.go:231-235).
+    # None = auto: on below ~4M P*N cells, off above (the audit is host-side
+    # numpy); True/False force it.
+    validate_assignment: Optional[bool] = None
+
 
 def model(**states: tuple[int, int]) -> PartitionModel:
     """Convenience builder: model(primary=(0, 1), replica=(1, 2))."""

@@ -12,13 +12,18 @@ rounds on its partition shard with 1/n of every node's capacity, and psums
 its per-node accepted weight so the price/counts every shard sees stay
 globally consistent.  No gather of [P, N] ever materializes on one chip.
 
-The node axis is kept replicated in round 1; for >> 10k-node problems a
-second mesh axis with cross-shard argmin (pmin + index arithmetic) is the
-planned extension.
+For >> 10k-node problems a SECOND mesh axis shards the node dimension of
+every [P, N] intermediate (make_mesh_2d): per-row (min, argmin, second)
+stats combine across node shards via all_gather + index arithmetic, remote
+column reads ride a masked psum, and the [N]-sized vectors (counts,
+capacity, prices — kilobytes) stay replicated along the node axis so the
+capacity/acceptance logic is identical math everywhere (see
+plan/tensor.py solve_dense's node_axis docs).
 """
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Optional
 
@@ -31,10 +36,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.encode import DenseProblem
 from ..plan.tensor import solve_dense_converged
 
-__all__ = ["make_mesh", "make_hybrid_mesh", "solve_dense_sharded",
-           "pad_partitions"]
+__all__ = ["make_mesh", "make_mesh_2d", "make_hybrid_mesh",
+           "solve_dense_sharded", "pad_partitions", "pad_nodes"]
 
 PARTITION_AXIS = "parts"
+NODE_AXIS = "nodes"
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -69,6 +75,24 @@ def make_hybrid_mesh() -> Mesh:
     return make_mesh()
 
 
+def make_mesh_2d(
+    parts_shards: int, node_shards: int,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """2-D (parts x nodes) device mesh for node-axis sharding.
+
+    Lay the node axis minor (fastest-varying over adjacent devices): its
+    per-round all_gather/psum of [P_l]-sized stats is the latency-bound
+    traffic, so it should ride the shortest ICI hops.
+    """
+    devs = list(jax.devices() if devices is None else devices)
+    need = parts_shards * node_shards
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(parts_shards, node_shards)
+    return Mesh(arr, (PARTITION_AXIS, NODE_AXIS))
+
+
 def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
     """Pad axis 0 to a multiple of the mesh size.
 
@@ -81,6 +105,22 @@ def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
         return arr
     pad_shape = (rem,) + arr.shape[1:]
     return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+def pad_nodes(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+    """Pad the trailing (node) axis to a multiple of the node-shard count.
+
+    Padding nodes are invalid (valid=False ⇒ zero capacity, +INF score,
+    gid_valid=False), so they can never be chosen; assignments therefore
+    only ever reference real node ids.
+    """
+    n = arr.shape[-1]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr
+    pad_shape = arr.shape[:-1] + (rem,)
+    return np.concatenate(
+        [arr, np.full(pad_shape, fill, arr.dtype)], axis=-1)
 
 
 def solve_dense_sharded(
@@ -98,17 +138,45 @@ def solve_dense_sharded(
 ) -> np.ndarray:
     """Run the converged solve under shard_map, partition axis sharded.
 
-    Returns assign[P_original, S, R] (padding stripped).
+    Accepts a 1-D ("parts",) or 2-D ("parts", "nodes") mesh (make_mesh /
+    make_mesh_2d).  On a 2-D mesh the [P, N] intermediates inside the
+    solver are sharded on BOTH axes; inputs here stay partition-sharded +
+    node-replicated ([N] vectors are small — the memory that matters is
+    the solver's internal [P, N] score, which is what the node axis
+    splits).  Returns assign[P_original, S, R] (padding stripped).
     """
-    n_shards = mesh.devices.size
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_shards = axes[PARTITION_AXIS]
+    node_shards = axes.get(NODE_AXIS, 1)
+    node_axis = NODE_AXIS if node_shards > 1 else None
     p_orig = prev.shape[0]
 
     prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
     pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
     st_p = pad_partitions(np.asarray(stickiness), n_shards, 0.0)
+    nw_p = np.asarray(nweights)
+    valid_p = np.asarray(valid)
+    gids_p = np.asarray(gids)
+    gv_p = np.asarray(gid_valid)
+    if node_shards > 1:
+        nw_p = pad_nodes(nw_p, node_shards, 1.0)
+        valid_p = pad_nodes(valid_p, node_shards, False)
+        gids_p = pad_nodes(gids_p, node_shards, -1)
+        gv_p = pad_nodes(gv_p, node_shards, False)
 
     shard = P(PARTITION_AXIS)
     rep = P()
+
+    # The output is node-replicated by construction (every node shard
+    # derives identical assignments from the all_gathered stats), which
+    # the varying-axes checker can't prove — disable it on 2-D meshes.
+    sm_kwargs = {}
+    if node_axis:
+        params = inspect.signature(jax.shard_map).parameters
+        for kw in ("check_vma", "check_rep"):
+            if kw in params:
+                sm_kwargs[kw] = False
+                break
 
     fn = jax.shard_map(
         partial(
@@ -117,21 +185,24 @@ def solve_dense_sharded(
             rules=rules,
             axis_name=PARTITION_AXIS,
             max_iterations=max_iterations,
+            node_axis=node_axis,
+            node_shards=node_shards,
         ),
         mesh=mesh,
         in_specs=(shard, shard, rep, rep, shard, rep, rep),
         out_specs=shard,
+        **sm_kwargs,
     )
 
     device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
     assign = fn(
         device_put(jnp.asarray(prev_p), shard),
         device_put(jnp.asarray(pw_p), shard),
-        device_put(jnp.asarray(nweights), rep),
-        device_put(jnp.asarray(valid), rep),
+        device_put(jnp.asarray(nw_p), rep),
+        device_put(jnp.asarray(valid_p), rep),
         device_put(jnp.asarray(st_p), shard),
-        device_put(jnp.asarray(gids), rep),
-        device_put(jnp.asarray(gid_valid), rep),
+        device_put(jnp.asarray(gids_p), rep),
+        device_put(jnp.asarray(gv_p), rep),
     )
     return np.asarray(assign)[:p_orig]
 

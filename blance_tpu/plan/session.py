@@ -214,6 +214,10 @@ class PlannerSession:
                 jnp.asarray(prob.gids),
                 jnp.asarray(prob.gid_valid),
                 constraints, rules, max_iterations=iters))
+        from .tensor import maybe_validate
+
+        maybe_validate(prob, assign, self.opts.validate_assignment,
+                       "PlannerSession.replan")
         self.proposed = assign
         return assign
 

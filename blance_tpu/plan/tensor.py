@@ -53,7 +53,7 @@ from ..ops.reduce2 import (
 )
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
-           "check_assignment"]
+           "check_assignment", "maybe_validate"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -80,19 +80,14 @@ def _scatter_counts(ids: jnp.ndarray, weights: jnp.ndarray, n: int) -> jnp.ndarr
     return jnp.zeros(n, jnp.float32).at[flat].add(w, mode="drop")
 
 
-def _membership(ids: jnp.ndarray, n: int) -> jnp.ndarray:
-    """[P, R] node ids -> [P, N] bool membership; -1 entries dropped."""
-    p = ids.shape[0]
-    out = jnp.zeros((p, n), jnp.bool_)
-    return out.at[jnp.arange(p)[:, None], _drop_empty(ids, n)].set(
-        True, mode="drop")
 
 
 def _hier_penalty(
-    anchors: jnp.ndarray,  # [P, A] node ids, -1 = absent anchor
-    gids: jnp.ndarray,  # [L, N]
-    gid_valid: jnp.ndarray,  # [L, N]
+    anchors: jnp.ndarray,  # [P, A] GLOBAL node ids, -1 = absent anchor
+    gids: jnp.ndarray,  # [L, N] full (anchor lookups are global)
+    gid_valid: jnp.ndarray,  # [L, N] full
     rules: tuple,  # ((include_level, exclude_level), ...)
+    gids_cand: Optional[jnp.ndarray] = None,  # [L, N_l] candidate columns
 ) -> jnp.ndarray:
     """Tiered rule penalty [P, N] anchored on EVERY prior pick at once.
 
@@ -108,19 +103,26 @@ def _hier_penalty(
     flat — the reference's fall-back-to-flat-candidates behavior
     (plan.go:214-220).  A ~ 1 + constraints, so the anchor loop unrolls
     into a handful of [P, N] comparisons that XLA fuses into the score
-    expression — no [P, N, A] tensor materializes."""
+    expression — no [P, N, A] tensor materializes.
+
+    Under node-axis sharding, ``gids_cand`` holds only this shard's
+    candidate columns (the output is [P, N_local]) while anchor lookups
+    still index the full replicated tables; validity gates on the anchor
+    side only, exactly like the replicated path."""
+    if gids_cand is None:
+        gids_cand = gids
     p, a_width = anchors.shape
-    n = gids.shape[1]
+    n_l = gids_cand.shape[1]
     any_anchor = jnp.any(anchors >= 0, axis=1)
-    pen = jnp.full((p, n), _RULE_MISS, jnp.float32)
+    pen = jnp.full((p, n_l), _RULE_MISS, jnp.float32)
     for idx, (inc, exc) in enumerate(rules):
-        sat = jnp.ones((p, n), jnp.bool_)
+        sat = jnp.ones((p, n_l), jnp.bool_)
         for ai in range(a_width):
             anc = anchors[:, ai]
             aa = jnp.maximum(anc, 0)
-            inc_same = (gids[inc][aa][:, None] == gids[inc][None, :]) & \
+            inc_same = (gids[inc][aa][:, None] == gids_cand[inc][None, :]) & \
                 gid_valid[inc][aa][:, None]
-            exc_same = (gids[exc][aa][:, None] == gids[exc][None, :]) & \
+            exc_same = (gids[exc][aa][:, None] == gids_cand[exc][None, :]) & \
                 gid_valid[exc][aa][:, None]
             sat &= jnp.where((anc >= 0)[:, None], inc_same & ~exc_same, True)
         pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
@@ -129,6 +131,95 @@ def _hier_penalty(
 
 def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name else x
+
+
+# --- node-axis sharding helpers ------------------------------------------
+#
+# Under a 2-D mesh (parts x nodes) every [N] vector (counts, capacity,
+# prices) stays REPLICATED along the node axis — at the north-star 10k
+# nodes that's kilobytes — while every [P, N] matrix (score, penalty,
+# taken, membership) is sharded on its node axis.  Acceptance/capacity
+# logic therefore runs as identical replicated math on every node shard;
+# the only node-axis collectives are (a) combining per-row (min, argmin,
+# second) stats and (b) fetching a matrix value at a remote column.
+
+
+def _node_off(node_axis: Optional[str], n_l: int):
+    """Global column offset of this node shard."""
+    return lax.axis_index(node_axis) * n_l if node_axis else 0
+
+
+def _node_slice(vec: jnp.ndarray, node_axis: Optional[str], n_l: int):
+    """Local [.., N_l] slice of a node-replicated [.., N] array."""
+    if not node_axis:
+        return vec
+    return lax.dynamic_slice_in_dim(
+        vec, _node_off(node_axis, n_l), n_l, axis=vec.ndim - 1)
+
+
+def _membership_local(
+    ids: jnp.ndarray, n_l: int, offset
+) -> jnp.ndarray:
+    """[P, R] GLOBAL node ids -> [P, N_l] membership of local columns."""
+    p = ids.shape[0]
+    loc = ids - offset
+    ok = (ids >= 0) & (loc >= 0) & (loc < n_l)
+    out = jnp.zeros((p, n_l), jnp.bool_)
+    return out.at[jnp.arange(p)[:, None], jnp.where(ok, loc, n_l)].set(
+        True, mode="drop")
+
+
+def _gather_cols(
+    mat: jnp.ndarray,  # [P, N_l]
+    rows: jnp.ndarray,  # [P] row ids
+    cols_global: jnp.ndarray,  # [P] GLOBAL column ids (>= 0)
+    node_axis: Optional[str],
+) -> jnp.ndarray:
+    """mat[rows, cols] with global column ids: the owner shard supplies the
+    value, a masked psum over the node axis delivers it everywhere."""
+    n_l = mat.shape[1]
+    loc = cols_global - _node_off(node_axis, n_l)
+    ok = (loc >= 0) & (loc < n_l)
+    vals = mat[rows, jnp.clip(loc, 0, n_l - 1)]
+    if not node_axis:
+        return vals
+    return lax.psum(jnp.where(ok, vals, 0.0), node_axis)
+
+
+def _row_min_global(mat: jnp.ndarray, node_axis: Optional[str]):
+    """Per-row min over the full (sharded) column axis."""
+    m = jnp.min(mat, axis=1)
+    return lax.pmin(m, node_axis) if node_axis else m
+
+
+def _combine_min2(
+    best_l: jnp.ndarray,  # [P] local best (priced)
+    choice_g: jnp.ndarray,  # [P] GLOBAL id of local argmin
+    second_l: jnp.ndarray,  # [P] local second-best
+    raw_l: jnp.ndarray,  # [P] unpriced score at the local argmin
+    node_axis: Optional[str],
+):
+    """Merge per-shard (min, argmin, second, raw-at-min) into global stats.
+
+    Global second = min(second of the winning shard, best of every other
+    shard).  Ties in best break toward the lowest shard index = lowest
+    global node id, preserving the replicated-node tie-break order."""
+    if not node_axis:
+        return best_l, choice_g, second_l, raw_l
+    bests = lax.all_gather(best_l, node_axis)  # [ns, P]
+    choices = lax.all_gather(choice_g, node_axis)
+    seconds = lax.all_gather(second_l, node_axis)
+    raws = lax.all_gather(raw_l, node_axis)
+    ns = bests.shape[0]
+    k_star = jnp.argmin(bests, axis=0)  # [P]
+
+    def take(a):
+        return jnp.take_along_axis(a, k_star[None, :], axis=0)[0]
+
+    others = jnp.where(
+        jnp.arange(ns)[:, None] == k_star[None, :], jnp.inf, bests)
+    second = jnp.minimum(take(seconds), jnp.min(others, axis=0))
+    return take(bests), take(choices), second, take(raws)
 
 
 def _shard_capacity(cap: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
@@ -235,7 +326,7 @@ def _pin_prev_holders(
 
 
 def _assign_slot(
-    score: jnp.ndarray,  # [P, N] (forbidden already folded in as +_INF)
+    score: jnp.ndarray,  # [P, N_local] (forbidden already folded in as +_INF)
     pweights: jnp.ndarray,  # [P]
     cap: jnp.ndarray,  # [N] weighted capacity for this slot (global)
     price_scale: jnp.ndarray,  # [N] converts accepted weight into score units
@@ -243,8 +334,9 @@ def _assign_slot(
     axis_name: Optional[str],
     init_assign: Optional[jnp.ndarray] = None,  # [P] warm-start (or -1)
     init_used: Optional[jnp.ndarray] = None,  # [N] weight behind the warm start
+    node_axis: Optional[str] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Auction: returns (slot_assign[P] int32 node id or -1, used[N] weight).
+    """Auction: returns (slot_assign[P] int32 GLOBAL node id or -1, used[N]).
 
     Each round: bid on the best open node, accept most-urgent bidders up to
     remaining capacity (at least the first bidder per node, to guarantee
@@ -252,20 +344,26 @@ def _assign_slot(
     ``init_assign``/``init_used`` seed the loop with pre-pinned placements
     (the warm start); pinned partitions never rebid.
 
-    Entirely shard-local: under shard_map the caller hands each shard its
-    slice of capacity and psums the returned per-node usage afterwards —
-    no collectives run inside the loop, so shards may take different round
-    counts.
+    Partition axis: entirely shard-local — the caller hands each shard its
+    slice of capacity and psums the returned per-node usage afterwards, so
+    shards may take different round counts.  Node axis: ``score`` holds
+    only this shard's columns while cap/price/used stay replicated [N];
+    each round runs one all_gather (per-row min stats) and one masked psum
+    (remote column reads) over ``node_axis`` — everything else is
+    identical replicated math on every node shard.
     """
-    p, n = score.shape
+    p, n_l = score.shape
+    n = cap.shape[0]
+    noff = _node_off(node_axis, n_l)
 
     # Deterministic tie-break jitter (Weyl-style hash of (partition, node))
     # so equal-score bids spread over equal nodes instead of herding.  The
-    # hash uses the GLOBAL partition index — with a shard-local index every
-    # shard would bid on the same jitter-preferred nodes in lockstep.
+    # hash uses GLOBAL partition and node indices — shard-local indices
+    # would make every shard bid on the same jitter-preferred columns in
+    # lockstep (and break node-shard-count invariance of the hash).
     base = lax.axis_index(axis_name) * p if axis_name else 0
     pi = (base + jnp.arange(p, dtype=jnp.uint32))[:, None].astype(jnp.uint32)
-    ni = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    ni = (jnp.uint32(noff) + jnp.arange(n_l, dtype=jnp.uint32))[None, :]
     jitter = ((pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503))
               & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
     score = score + jitter_scale * jitter
@@ -274,7 +372,21 @@ def _assign_slot(
     # whether a straggler still has rule-satisfying options.  Computed once
     # here — XLA cannot hoist a [P, N] reduction out of the while_loop body
     # on its own.
-    raw_best_all = jnp.min(score, axis=1)
+    raw_best_all = _row_min_global(score, node_axis)
+
+    def _priced_min2(price_vec):
+        """Local fused min2 over this shard's columns + global combine:
+        returns (best, choice[global id], second, raw-at-choice), identical
+        on every node shard."""
+        price_l = _node_slice(price_vec, node_axis, n_l)
+        if pallas_available():
+            best_l, choice_l, second_l = priced_min2_argmin(score, price_l)
+        else:
+            best_l, choice_l, second_l = min2_argmin_reference(
+                score + price_l[None, :])
+        raw_l = jnp.take_along_axis(score, choice_l[:, None], axis=1)[:, 0]
+        return _combine_min2(
+            best_l, choice_l + noff, second_l, raw_l, node_axis)
 
     def round_body(carry):
         slot_assign, unassigned, rem_cap, used, _progress, it = carry
@@ -282,19 +394,24 @@ def _assign_slot(
         # Price: weight already accepted this slot raises a node's cost as
         # if the counts term had updated, so bids keep spreading even
         # within one slot wave; closed nodes cost +_INF.
+        # The fused (min, argmin, second-min) over score + price runs in
+        # one HBM pass with the price row folded in VMEM via the Pallas
+        # kernel on TPU (blance_tpu/ops/reduce2.py); the XLA spelling
+        # (priced [P, N] materialization + 3 reductions) elsewhere.
         price_vec = used * price_scale + jnp.where(rem_cap > 0, 0.0, _INF)
-        # Fused (min, argmin, second-min) over score + price — one HBM pass
-        # with the price row folded in VMEM via the Pallas kernel on TPU
-        # (blance_tpu/ops/reduce2.py); the XLA spelling (priced [P, N]
-        # materialization + 3 reductions + a position-mask copy) elsewhere.
-        if pallas_available():
-            best, choice, second = priced_min2_argmin(score, price_vec)
-        else:
-            best, choice, second = min2_argmin_reference(
-                score + price_vec[None, :])
+        best, choice, second, raw_choice = _priced_min2(price_vec)
         margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
 
-        active = unassigned & (best < _INF / 2)
+        # Rules-first gate (mirrors phase B's soft_ok): when every
+        # rule-satisfying node is priced closed — common under shard_map,
+        # where each shard holds only 1/ns of a node's capacity — the
+        # priced argmin falls through to a rule-missing node.  Don't bid
+        # it: wait for capacity-ignoring force, which prefers the
+        # satisfying nodes (rule conformance beats balance, like the
+        # reference's hierarchy-pass-first ordering, plan.go:174-226).
+        rule_ok = (raw_choice < _RULE_MISS / 2) | \
+            (raw_best_all >= _RULE_MISS / 2)
+        active = unassigned & (best < _INF / 2) & rule_ok
 
         # Sort bidders by (node, urgency desc) via two stable argsorts —
         # avoids packing into int64, which is x64-gated.  Inactive bidders
@@ -346,7 +463,7 @@ def _assign_slot(
         in_range = pos < n
         choice2 = node_order[jnp.clip(pos, 0, n - 1)]
 
-        raw2 = score[sperm, choice2]
+        raw2 = _gather_cols(score, sperm, choice2, node_axis)
         raw_best = raw_best_all[sperm]
         hard_ok = raw2 < _INF / 2
         soft_ok = (raw2 < _RULE_MISS / 2) | (raw_best >= _RULE_MISS / 2)
@@ -381,27 +498,31 @@ def _assign_slot(
         jnp.array(True),
         jnp.array(0, jnp.int32),
     )
-    if axis_name:
+    for ax in (axis_name, node_axis):
+        if not ax:
+            continue
         # Freshly-created carries are axis-invariant until the (shard-local)
         # loop body makes them varying; mark them varying up front so carry
         # types agree.  Skip values that are already varying.
         _to_varying = (
-            (lambda x: lax.pcast(x, (axis_name,), to="varying"))
+            (lambda x: lax.pcast(x, (ax,), to="varying"))
             if hasattr(lax, "pcast")
-            else (lambda x: lax.pvary(x, (axis_name,))))
+            else (lambda x: lax.pvary(x, (ax,))))
 
         def ensure_varying(x):
             vma = getattr(jax.typeof(x), "vma", frozenset())
-            return x if axis_name in vma else _to_varying(x)
+            return x if ax in vma else _to_varying(x)
         init = tuple(ensure_varying(x) for x in init)
     slot_assign, unassigned, _rem, used, _, _ = lax.while_loop(
         round_cond, round_body, init)
 
     # Force step: remaining partitions take their best feasible node,
-    # ignoring capacity (constraint satisfaction beats balance).
-    priced = score + (used * price_scale)[None, :]
-    best = jnp.min(priced, axis=1)
-    choice = jnp.argmin(priced, axis=1).astype(jnp.int32)
+    # ignoring capacity (constraint satisfaction beats balance).  Price on
+    # the GLOBAL usage (one [N] psum): each shard's force sees every
+    # shard's accepted weight, or all shards would pile their stragglers
+    # onto the same locally-cheapest node.
+    used_global = _psum(used, axis_name)
+    best, choice, _second, _raw = _priced_min2(used_global * price_scale)
     feasible = best < _INF / 2
     forced = unassigned & feasible
     slot_assign = jnp.where(forced, choice, slot_assign)
@@ -412,20 +533,31 @@ def _assign_slot(
     return slot_assign, used
 
 
-@partial(jax.jit, static_argnames=("constraints", "rules", "axis_name"))
+@partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
+                                   "node_axis", "node_shards"))
 def solve_dense(
-    prev: jnp.ndarray,  # [P, S, R] int32
+    prev: jnp.ndarray,  # [P, S, R] int32 (GLOBAL node ids)
     pweights: jnp.ndarray,  # [P] float32
-    nweights: jnp.ndarray,  # [N] float32
-    valid: jnp.ndarray,  # [N] bool
+    nweights: jnp.ndarray,  # [N] float32 (full, node-replicated)
+    valid: jnp.ndarray,  # [N] bool (full)
     stickiness: jnp.ndarray,  # [P, S] float32
-    gids: jnp.ndarray,  # [L, N] int32
-    gid_valid: jnp.ndarray,  # [L, N] bool
+    gids: jnp.ndarray,  # [L, N] int32 (full)
+    gid_valid: jnp.ndarray,  # [L, N] bool (full)
     constraints: tuple,  # static, per-state slot counts
     rules: tuple,  # static, per-state tuple of (inc, exc) pairs
     axis_name: Optional[str] = None,  # static; set under shard_map
+    node_axis: Optional[str] = None,  # static; second mesh axis over nodes
+    node_shards: int = 1,  # static; size of the node axis (N must divide)
 ) -> jnp.ndarray:
-    """Solve the whole placement problem on device; returns assign[P, S, R]."""
+    """Solve the whole placement problem on device; returns assign[P, S, R].
+
+    With ``node_axis`` set (a 2-D parts x nodes mesh), every [P, N]
+    intermediate — score, penalties, stickiness/taken masks — holds only
+    this shard's N/node_shards columns, while [N] vectors (counts,
+    capacity, prices) stay replicated along the node axis: at the
+    north-star scale those are kilobytes and keeping them replicated makes
+    all capacity/acceptance logic identical math on every node shard.
+    Node ids in prev/assign are global throughout."""
     p, s, r_max = prev.shape
     n = nweights.shape[0]
     if constraints and max(constraints) > r_max:
@@ -433,6 +565,13 @@ def solve_dense(
         # slots beyond R would vanish while still consuming capacity.
         raise ValueError(
             f"prev slot depth R={r_max} < max constraints {max(constraints)}")
+    if n % node_shards:
+        raise ValueError(
+            f"N={n} not divisible by node_shards={node_shards}; pad nodes")
+    n_l = n // node_shards
+    noff = _node_off(node_axis, n_l)
+    valid_l = _node_slice(valid, node_axis, n_l)
+    gids_l = _node_slice(gids, node_axis, n_l)
 
     total_p = _psum(jnp.array(p, jnp.float32), axis_name)
     total_w = _psum(jnp.sum(pweights), axis_name)
@@ -465,8 +604,9 @@ def solve_dense(
 
     assign = jnp.full((p, s, r_max), -1, jnp.int32)
     # Nodes already holding this partition at an equal-or-higher priority
-    # state in this pass (excludeHigherPriorityNodes, plan.go:146-156).
-    taken = jnp.zeros((p, n), jnp.bool_)
+    # state in this pass (excludeHigherPriorityNodes, plan.go:146-156);
+    # local columns only under node sharding.
+    taken = jnp.zeros((p, n_l), jnp.bool_)
 
     top_anchor = prev[:, 0, 0]  # previous primary, until slot (0,0) assigns
 
@@ -482,7 +622,8 @@ def solve_dense(
                            axis_name)
         total = total - state_prev
 
-        sticky_mask = _membership(prev[:, si, :], n)  # held this state before
+        # Held this state before (local columns).
+        sticky_mask = _membership_local(prev[:, si, :], n_l, noff)
         sticky_bonus = stickiness[:, si][:, None] * sticky_mask
 
         anchor = jnp.where(assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor) \
@@ -501,7 +642,11 @@ def solve_dense(
         prev_k = prev[:, si, :kk]  # [P, kk]
         safe_k = jnp.clip(prev_k, 0, n - 1)
         rows = jnp.arange(p)[:, None]
-        pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken[rows, safe_k]
+        taken_prev = jnp.stack(
+            [_gather_cols(taken.astype(jnp.float32), jnp.arange(p),
+                          safe_k[:, j], node_axis) > 0.5
+             for j in range(kk)], axis=1)
+        pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken_prev
         # An externally supplied prev map can repeat a node within one
         # state's row; only the first occurrence may pin, or both copies
         # would keep the same node — a duplicate the auction's exclusivity
@@ -534,11 +679,14 @@ def solve_dense(
             rows1 = jnp.arange(p)
             for j in range(kk):
                 hier_j = _hier_penalty(
-                    anchors[:, :1 + j], gids, gid_valid, rules[si])
-                floor_j = jnp.min(
-                    jnp.where(valid[None, :], hier_j, _INF), axis=1)
+                    anchors[:, :1 + j], gids, gid_valid, rules[si],
+                    gids_cand=gids_l)
+                floor_j = _row_min_global(
+                    jnp.where(valid_l[None, :], hier_j, _INF), node_axis)
+                hier_at_prev = _gather_cols(
+                    hier_j, rows1, safe_k[:, j], node_axis)
                 ok_j = pin_ok_k[:, j] & (
-                    hier_j[rows1, safe_k[:, j]] < floor_j + _RULE_TIER * 0.5)
+                    hier_at_prev < floor_j + _RULE_TIER * 0.5)
                 pin_ok_k = pin_ok_k.at[:, j].set(ok_j)
                 anchors = anchors.at[:, 1 + j].set(
                     jnp.where(ok_j, prev_k[:, j], -1))
@@ -555,8 +703,8 @@ def solve_dense(
         # Same-partition exclusivity: later ordinals' pins must be invisible
         # to earlier ordinals' auctions, or a displaced slot-0 copy could
         # land on the node slot-1 keeps pinned.
-        taken = taken.at[rows, jnp.where(pins, safe_k, n)].set(
-            True, mode="drop")
+        taken = taken | _membership_local(
+            jnp.where(pins, prev_k, -1), n_l, noff)
         if rules[si]:
             # Re-seed anchors from the capacity-trimmed pins: a trimmed pin
             # must not keep excluding its rack from the auction, while a
@@ -588,19 +736,24 @@ def solve_dense(
                 """Score + auction + force for this slot — the expensive
                 path, skipped entirely when every copy pinned (converged
                 passes of solve_dense_converged land here for every slot,
-                so the confirming pass never touches a [P, N] tensor)."""
-                balance = 0.001 * total[None, :] / jnp.maximum(total_p, 1.0)
-                score = balance / w_div[None, :]
+                so the confirming pass never touches a [P, N] tensor).
+                All [P, N_l]-shaped terms use local columns; [N] vectors
+                slice their local window on the fly."""
+                total_l = _node_slice(total, node_axis, n_l)
+                w_div_l = _node_slice(w_div, node_axis, n_l)
+                neg_boost_l = _node_slice(neg_boost, node_axis, n_l)
+                balance = 0.001 * total_l[None, :] / jnp.maximum(total_p, 1.0)
+                score = balance / w_div_l[None, :]
                 # Same-ordinal alignment: slot ri mildly prefers prev slot
                 # ri's node (above jitter, below every real term), so
                 # sticky bids don't scramble ordinals and leftovers stay
                 # spread.
                 if ri < r_max:
-                    score = score - 0.01 * _membership(
-                        prev[:, si, ri:ri + 1], n)
+                    score = score - 0.01 * _membership_local(
+                        prev[:, si, ri:ri + 1], n_l, noff)
                 score = score + jnp.maximum(
-                    neg_boost[None, :],
-                    jnp.where(neg_boost[None, :] > 0,
+                    neg_boost_l[None, :],
+                    jnp.where(neg_boost_l[None, :] > 0,
                               stickiness[:, si][:, None], 0.0))
                 score = score - sticky_bonus
                 # Per-slot rule penalty: anchored on the primary, every
@@ -612,8 +765,9 @@ def solve_dense(
                 # branch captures only the small [P, 1+k] anchors.
                 if rules[si]:
                     score = score + _hier_penalty(
-                        anchors, gids, gid_valid, rules[si])
-                score = score + _INF * (taken | ~valid[None, :])
+                        anchors, gids, gid_valid, rules[si],
+                        gids_cand=gids_l)
+                score = score + _INF * (taken | ~valid_l[None, :])
 
                 # Exact ceil capacity: the binding rail that yields tight
                 # balance; exclusivity stragglers rebid under the in-slot
@@ -622,7 +776,8 @@ def solve_dense(
                     jnp.ceil(total_w * cap_share), axis_name)
                 return _assign_slot(
                     score, pweights, cap, 1.0 / w_div, jitter_scale,
-                    axis_name, init_assign=init_assign, init_used=pin_used)
+                    axis_name, init_assign=init_assign, init_used=pin_used,
+                    node_axis=node_axis)
 
             def keep_pins(_):
                 return init_assign, pin_used
@@ -636,8 +791,8 @@ def solve_dense(
 
             assign = assign.at[:, si, ri].set(slot_assign)
             total = total + used
-            safe_slot = _drop_empty(slot_assign, n)
-            taken = taken.at[jnp.arange(p), safe_slot].set(True, mode="drop")
+            taken = taken | _membership_local(
+                slot_assign[:, None], n_l, noff)
             if rules[si]:
                 anchors = anchors.at[:, 1 + ri].set(slot_assign)
 
@@ -645,7 +800,8 @@ def solve_dense(
 
 
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
-                                   "max_iterations"))
+                                   "max_iterations", "node_axis",
+                                   "node_shards"))
 def solve_dense_converged(
     prev: jnp.ndarray,
     pweights: jnp.ndarray,
@@ -658,6 +814,8 @@ def solve_dense_converged(
     rules: tuple,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
 ) -> jnp.ndarray:
     """solve_dense iterated to a fixpoint (reference plan.go:23-58).
 
@@ -670,8 +828,12 @@ def solve_dense_converged(
     passes re-balance on the stable node set (plan.go:49-55; removed nodes
     hold nothing after pass 1, so a constant valid mask is equivalent).
     """
-    first = solve_dense(prev, pweights, nweights, valid, stickiness,
-                        gids, gid_valid, constraints, rules, axis_name)
+    def solve(x):
+        return solve_dense(x, pweights, nweights, valid, stickiness,
+                           gids, gid_valid, constraints, rules, axis_name,
+                           node_axis, node_shards)
+
+    first = solve(prev)
 
     def cond(carry):
         out, prev_i, it = carry
@@ -682,9 +844,7 @@ def solve_dense_converged(
 
     def body(carry):
         out, _prev, it = carry
-        nxt = solve_dense(out, pweights, nweights, valid, stickiness,
-                          gids, gid_valid, constraints, rules, axis_name)
-        return nxt, out, it + 1
+        return solve(out), out, it + 1
 
     out, _, _ = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
     return out
@@ -698,35 +858,71 @@ def check_assignment(
     Counts (a) slot shortfalls beyond what an honest solver could fill,
     (b) same-partition node duplicates across states/slots, (c) assignments
     to removed nodes.  Hierarchy-rule misses are reported separately (they
-    degrade softly, like the reference's warnings, when unmeetable)."""
+    degrade softly, like the reference's warnings, when unmeetable).
+
+    Pure numpy (three row-sort reductions), cheap enough to run after
+    every production solve — see ``validate_assignment`` wiring in
+    plan_next_map_tpu / PlannerSession.replan."""
     assign = np.asarray(assign)
     P, S, R = assign.shape
     n_valid = int(problem.valid_node.sum())
+    if P == 0:
+        return {"duplicates": 0, "on_removed_nodes": 0,
+                "unfilled_feasible_slots": 0}
 
-    dup = 0
-    removed = 0
+    def row_dups(rows: np.ndarray) -> np.ndarray:
+        """Per row: count of valid entries equal to an earlier entry."""
+        srt = np.sort(rows, axis=1)
+        return ((srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] >= 0)).sum(axis=1)
+
+    flat = assign.reshape(P, S * R)
+    dup = int(row_dups(flat).sum())
+    held = flat[flat >= 0]
+    removed = int((~problem.valid_node[held]).sum())
+
+    # Shortfall per (partition, state): want vs got, capped by what an
+    # honest solver could still fill given the distinct nodes the
+    # partition already occupies through this state (prefix-distinct).
     shortfall = 0
-    for pi in range(P):
-        seen = set()
-        for si in range(S):
-            want = int(problem.constraints[si])
-            got = 0
-            for ri in range(R):
-                node = int(assign[pi, si, ri])
-                if node < 0:
-                    continue
-                got += 1
-                if node in seen:
-                    dup += 1
-                seen.add(node)
-                if not problem.valid_node[node]:
-                    removed += 1
-            if want > 0:
-                achievable = min(want, max(n_valid - len(seen) + got, 0))
-                if got < min(want, achievable):
-                    shortfall += min(want, achievable) - got
+    got_ps = (assign >= 0).sum(axis=2)  # [P, S]
+    for si in range(S):
+        want = int(problem.constraints[si])
+        if want <= 0:
+            continue
+        pre = assign[:, :si + 1, :].reshape(P, -1)
+        distinct = (pre >= 0).sum(axis=1) - row_dups(pre)
+        got = got_ps[:, si]
+        achievable = np.minimum(want, np.maximum(n_valid - distinct + got, 0))
+        shortfall += int(np.maximum(achievable - got, 0).sum())
     return {"duplicates": dup, "on_removed_nodes": removed,
             "unfilled_feasible_slots": shortfall}
+
+
+# Auto-validation ceiling: below this many [P, N] score cells the numpy
+# audit is noise next to the solve; above it, opt in explicitly.
+_VALIDATE_AUTO_CELLS = 1 << 22
+
+
+def maybe_validate(
+    problem: DenseProblem, assign: np.ndarray, validate: Optional[bool],
+    context: str,
+) -> Optional[dict[str, int]]:
+    """Run check_assignment per the ``validate_assignment`` policy and
+    surface violations as a UserWarning (reference analogue: constraint
+    problems degrade to warnings, plan.go:231-235).  Returns the counts
+    when the check ran, else None."""
+    import warnings as _warnings
+
+    if validate is None:
+        validate = problem.P * problem.N <= _VALIDATE_AUTO_CELLS
+    if not validate:
+        return None
+    counts = check_assignment(problem, assign)
+    if any(counts.values()):
+        _warnings.warn(
+            f"blance_tpu {context}: solver produced a constraint-violating "
+            f"assignment: {counts}", UserWarning, stacklevel=3)
+    return counts
 
 
 def _tpu_supported(opts: PlanOptions) -> bool:
@@ -812,6 +1008,8 @@ def plan_next_map_tpu(
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
         ))
+    maybe_validate(problem, assign, opts.validate_assignment,
+                   "plan_next_map_tpu")
     with timer.phase("decode"):
         return decode_assignment(
             problem, assign, partitions_to_assign, nodes_to_remove)

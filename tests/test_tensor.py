@@ -279,6 +279,56 @@ def test_check_assignment_clean():
                       "unfilled_feasible_slots": 0}
 
 
+def test_check_assignment_counts_crafted_violations():
+    """Vectorized checker vs hand-counted violations on a crafted array."""
+    from blance_tpu.core.types import PlanOptions as PO
+    nodes = ["a", "b", "c", "d"]
+    parts = empty_parts(3)
+    problem = encode_problem({}, parts, nodes, ["d"], M_1P_2R, PO())
+    assert problem.R >= 2
+    assign = np.full((3, 2, problem.R), -1, np.int32)
+    # p0: primary a, replicas a+b -> 1 duplicate.
+    assign[0, 0, 0] = 0
+    assign[0, 1, 0] = 0
+    assign[0, 1, 1] = 1
+    # p1: primary on removed d, replicas b,c -> 1 on_removed.
+    assign[1, 0, 0] = 3
+    assign[1, 1, 0] = 1
+    assign[1, 1, 1] = 2
+    # p2: primary a, only one replica though 3 valid nodes -> 1 shortfall.
+    assign[2, 0, 0] = 0
+    assign[2, 1, 0] = 1
+    counts = check_assignment(problem, assign)
+    assert counts == {"duplicates": 1, "on_removed_nodes": 1,
+                      "unfilled_feasible_slots": 1}, counts
+
+
+def test_validation_gate_catches_broken_solver(monkeypatch):
+    """A deliberately-broken solve must fail through the production
+    validation gate (warnings by default at small P), not silently ship a
+    violating map."""
+    import warnings as w
+
+    from blance_tpu.plan import tensor as T
+
+    def broken_solve(prev, *args, **kwargs):
+        out = np.zeros(prev.shape, np.int32)  # everyone on node 0
+        return out
+
+    monkeypatch.setattr(T, "solve_dense_converged", broken_solve)
+    nodes = [f"n{i}" for i in range(4)]
+    with pytest.warns(UserWarning, match="constraint-violating"):
+        T.plan_next_map_tpu(
+            empty_parts(8), empty_parts(8), nodes, [], nodes, M_1P_1R)
+
+    # And the clean path stays silent.
+    monkeypatch.undo()
+    with w.catch_warnings():
+        w.simplefilter("error")
+        T.plan_next_map_tpu(
+            empty_parts(8), empty_parts(8), nodes, [], nodes, M_1P_1R)
+
+
 def test_degenerate_empty_partitions():
     # P == 0 must not crash the vectorized decode (tensor.py routes it there).
     result, warnings = plan_next_map(
