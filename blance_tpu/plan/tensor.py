@@ -850,6 +850,79 @@ def solve_dense_converged(
     return out
 
 
+def _anchor_sat_np(
+    anchor: np.ndarray,  # [P] node ids, -1 = absent
+    gids: np.ndarray,  # [L, N]
+    gid_valid: np.ndarray,  # [L, N]
+    rules: list[tuple[int, int]],
+) -> np.ndarray:
+    """Per-rule satisfaction [n_rules, P, N] for ONE anchor column: does
+    node n share the anchor's include-level ancestor and NOT its
+    exclude-level ancestor?  Absent anchors satisfy everything.  Validity
+    gates on the anchor side only, exactly like the device _hier_penalty."""
+    p = anchor.shape[0]
+    n = gids.shape[1]
+    aa = np.clip(anchor, 0, n - 1)
+    present = (anchor >= 0)[:, None]
+    out = np.ones((len(rules), p, n), bool)
+    for idx, (inc, exc) in enumerate(rules):
+        inc_same = (gids[inc][aa][:, None] == gids[inc][None, :]) & \
+            gid_valid[inc][aa][:, None]
+        exc_same = (gids[exc][aa][:, None] == gids[exc][None, :]) & \
+            gid_valid[exc][aa][:, None]
+        out[idx] = np.where(present, inc_same & ~exc_same, True)
+    return out
+
+
+def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
+    """Feasible-tier hierarchy misses: a copy counts when it sits at a
+    WORSE rule tier than some still-open valid node could have achieved
+    given the same anchors (the solver's prefix anchoring, reference
+    plan.go:185-191): state 0 anchors on the PREVIOUS primary (the
+    solver's top_anchor — never on the node being judged), later states
+    on the assigned primary plus the state's earlier picks.
+    Unsatisfiable rules never count: when no candidate reaches a better
+    tier, the flat fallback is correct behavior (plan.go:214-220).
+    Per-anchor rule satisfaction is folded in incrementally, so each
+    state costs one [n_rules, P, N] table plus one AND per ordinal."""
+    P, S, R = assign.shape
+    N = problem.N
+    if not any(problem.rules.get(si) for si in range(S)):
+        return 0
+    rows = np.arange(P)
+    top_anchor = problem.prev[:, 0, 0]
+    misses = 0
+    used = np.zeros((P, N), bool)  # nodes this partition already occupies
+    for si in range(S):
+        rules_si = problem.rules.get(si) or []
+        if rules_si:
+            big = len(rules_si)
+            base = top_anchor if si == 0 else np.where(
+                assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor)
+            sat = _anchor_sat_np(base, problem.gids, problem.gid_valid,
+                                 rules_si)
+            any_anchor = base >= 0
+        for j in range(R):
+            node_j = assign[:, si, j]
+            has = node_j >= 0
+            if rules_si and has.any():
+                tier = np.full((P, N), big, np.int32)
+                for idx in reversed(range(len(rules_si))):
+                    tier = np.where(sat[idx], idx, tier)
+                cand_ok = problem.valid_node[None, :] & ~used
+                attainable = np.min(np.where(cand_ok, tier, big), axis=1)
+                achieved = tier[rows, np.clip(node_j, 0, N - 1)]
+                misses += int((has & any_anchor
+                               & (achieved > attainable)).sum())
+            if rules_si:
+                # This pick anchors the state's later ordinals.
+                sat &= _anchor_sat_np(node_j, problem.gids,
+                                      problem.gid_valid, rules_si)
+                any_anchor = any_anchor | has
+            used[rows, np.clip(node_j, 0, N - 1)] |= has
+    return misses
+
+
 def check_assignment(
     problem: DenseProblem, assign: np.ndarray
 ) -> dict[str, int]:
@@ -857,18 +930,20 @@ def check_assignment(
 
     Counts (a) slot shortfalls beyond what an honest solver could fill,
     (b) same-partition node duplicates across states/slots, (c) assignments
-    to removed nodes.  Hierarchy-rule misses are reported separately (they
-    degrade softly, like the reference's warnings, when unmeetable).
+    to removed nodes, (d) feasible-tier hierarchy-rule misses — copies
+    placed at a worse rule tier than an open valid node could achieve
+    (unmeetable rules degrade softly to the flat fallback and do NOT
+    count, like the reference's warnings, plan.go:214-235).
 
-    Pure numpy (three row-sort reductions), cheap enough to run after
-    every production solve — see ``validate_assignment`` wiring in
-    plan_next_map_tpu / PlannerSession.replan."""
+    Pure numpy, cheap enough to run after every production solve — see
+    ``validate_assignment`` wiring in plan_next_map_tpu /
+    PlannerSession.replan."""
     assign = np.asarray(assign)
     P, S, R = assign.shape
     n_valid = int(problem.valid_node.sum())
     if P == 0:
         return {"duplicates": 0, "on_removed_nodes": 0,
-                "unfilled_feasible_slots": 0}
+                "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
 
     def row_dups(rows: np.ndarray) -> np.ndarray:
         """Per row: count of valid entries equal to an earlier entry."""
@@ -895,7 +970,8 @@ def check_assignment(
         achievable = np.minimum(want, np.maximum(n_valid - distinct + got, 0))
         shortfall += int(np.maximum(achievable - got, 0).sum())
     return {"duplicates": dup, "on_removed_nodes": removed,
-            "unfilled_feasible_slots": shortfall}
+            "unfilled_feasible_slots": shortfall,
+            "hierarchy_misses": _count_hier_misses(problem, assign)}
 
 
 # Auto-validation ceiling: below this many [P, N] score cells the numpy

@@ -62,7 +62,8 @@ def main():
 
     report = check_assignment(session.problem, session.current)
     assert report == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}, report
+                      "unfilled_feasible_slots": 0,
+                      "hierarchy_misses": 0}, report
     counts = np.bincount(session.current[session.current >= 0], minlength=N)
     print(f"final spread: {counts.max() - counts.min()} "
           f"(ideal per-node load {2 * P // N})")

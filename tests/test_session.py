@@ -30,7 +30,8 @@ def test_fresh_plan_satisfies_constraints():
     assert s.current.shape[0] == len(PARTS)
     report = check_assignment(s.problem, s.current)
     assert report == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}
+                      "unfilled_feasible_slots": 0,
+                      "hierarchy_misses": 0}
     # Balanced: every node holds roughly P*2/8 copies.
     counts = np.bincount(s.current[s.current >= 0], minlength=len(NODES))
     assert counts.max() - counts.min() <= 2
@@ -96,7 +97,8 @@ def test_add_nodes_attracts_load():
     assert all(counts[i] > 0 for i in new_ids)
     report = check_assignment(s.problem, s.current)
     assert report == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}
+                      "unfilled_feasible_slots": 0,
+                      "hierarchy_misses": 0}
 
 
 def test_readd_removed_node():

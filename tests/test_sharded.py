@@ -31,7 +31,7 @@ def test_sharded_solve_matches_contract():
 
     counts = check_assignment(problem, assign)
     assert counts == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}
+                      "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
 
     result, warnings = decode_assignment(problem, assign, parts, [])
     assert not warnings
@@ -87,7 +87,7 @@ def test_sharded_growth_migrates_pinned_load():
     assert counts.max() - counts.min() <= 6, counts
     report = check_assignment(prob2, a2)
     assert report == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}
+                      "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
 
 
 def test_hybrid_mesh_single_slice_fallback():
@@ -140,7 +140,7 @@ def test_shard_count_contract_invariance():
         assert _rule_violations(problem, a) == 0
         assert check_assignment(problem, a) == {
             "duplicates": 0, "on_removed_nodes": 0,
-            "unfilled_feasible_slots": 0}
+            "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
         for si in range(2):
             ids = a[:, si, :].ravel()
             loads = np.bincount(ids[ids >= 0], minlength=8)

@@ -27,7 +27,8 @@ from blance_tpu.parallel.sharded import (
 )
 from blance_tpu.plan.tensor import check_assignment
 
-CLEAN = {"duplicates": 0, "on_removed_nodes": 0, "unfilled_feasible_slots": 0}
+CLEAN = {"duplicates": 0, "on_removed_nodes": 0,
+         "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
 
 
 def empty_parts(n):

@@ -276,7 +276,8 @@ def test_check_assignment_clean():
                 assign[pi, sidx[s], ri] = nidx[node]
     counts = check_assignment(problem, assign)
     assert counts == {"duplicates": 0, "on_removed_nodes": 0,
-                      "unfilled_feasible_slots": 0}
+                      "unfilled_feasible_slots": 0,
+                      "hierarchy_misses": 0}
 
 
 def test_check_assignment_counts_crafted_violations():
@@ -300,7 +301,8 @@ def test_check_assignment_counts_crafted_violations():
     assign[2, 1, 0] = 1
     counts = check_assignment(problem, assign)
     assert counts == {"duplicates": 1, "on_removed_nodes": 1,
-                      "unfilled_feasible_slots": 1}, counts
+                      "unfilled_feasible_slots": 1,
+                      "hierarchy_misses": 0}, counts
 
 
 def test_validation_gate_catches_broken_solver(monkeypatch):
@@ -327,6 +329,101 @@ def test_validation_gate_catches_broken_solver(monkeypatch):
         w.simplefilter("error")
         T.plan_next_map_tpu(
             empty_parts(8), empty_parts(8), nodes, [], nodes, M_1P_1R)
+
+
+def _rack_setup(N=10, rack_size=2):
+    """Nodes on racks of ``rack_size``, replica rule (zone=2, rack=1)."""
+    from blance_tpu import HierarchyRule
+
+    nodes = [f"n{i}" for i in range(N)]
+    hier = {n: f"r{i // rack_size}" for i, n in enumerate(nodes)}
+    hier.update({f"r{i}": "z0"
+                 for i in range((N + rack_size - 1) // rack_size)})
+    opts = PlanOptions(node_hierarchy=hier,
+                       hierarchy_rules={"replica": [HierarchyRule(2, 1)]})
+    return nodes, opts
+
+
+def test_hier_misses_counted_on_crafted_assignment():
+    """check_assignment counts a copy at a worse tier than an open valid
+    node could achieve — and does NOT count unmeetable rules."""
+    nodes, opts = _rack_setup(N=8, rack_size=2)  # racks r0..r3 of 2
+    parts = empty_parts(2)
+    problem = encode_problem({}, parts, nodes, [], M_1P_2R, opts)
+    assert problem.rules  # replica state carries the (2, 1) rule
+    assign = np.full((2, 2, problem.R), -1, np.int32)
+    # p0: primary n0 (r0); replicas n2 (r1), n4 (r2) — conformant.
+    assign[0, 0, 0], assign[0, 1, 0], assign[0, 1, 1] = 0, 2, 4
+    # p1: primary n0 (r0); replica 0 on n1 (SAME rack r0) while racks
+    # r1..r3 had open nodes -> 1 feasible miss; replica 1 on n3 (r1) ok.
+    assign[1, 0, 0], assign[1, 1, 0], assign[1, 1, 1] = 0, 1, 3
+    counts = check_assignment(problem, assign)
+    assert counts["hierarchy_misses"] == 1, counts
+    assert counts["duplicates"] == 0
+
+    # Unmeetable: only 2 racks for primary + 2 replicas pairwise-spread —
+    # the flat fallback is correct behavior, not a miss.
+    nodes4, opts4 = _rack_setup(N=4, rack_size=2)  # racks r0, r1 only
+    p4 = encode_problem({}, empty_parts(1), nodes4, [], M_1P_2R, opts4)
+    a4 = np.full((1, 2, p4.R), -1, np.int32)
+    a4[0, 0, 0], a4[0, 1, 0], a4[0, 1, 1] = 0, 2, 3  # r1 twice: no choice
+    assert check_assignment(p4, a4)["hierarchy_misses"] == 0
+
+
+def test_primary_state_rules_no_false_misses():
+    """Rules on state 0 anchor on the PREVIOUS primary (the solver's
+    top_anchor), never on the node being judged — a correct fresh solve
+    must pass the gate silently (regression: self-anchoring made the
+    exclude test unsatisfiable by one's own node and flagged every
+    partition)."""
+    import warnings as w
+
+    from blance_tpu import HierarchyRule
+
+    nodes = [f"n{i}" for i in range(8)]
+    hier = {n: f"r{i // 2}" for i, n in enumerate(nodes)}
+    hier.update({f"r{i}": "z0" for i in range(4)})
+    opts = PlanOptions(node_hierarchy=hier,
+                       hierarchy_rules={"primary": [HierarchyRule(2, 1)]})
+    parts = empty_parts(16)
+    with w.catch_warnings():
+        w.simplefilter("error")
+        result, _ = plan_next_map_tpu({}, parts, nodes, [], nodes,
+                                      M_1P_1R, opts)
+    assert all(p.nodes_by_state["primary"] for p in result.values())
+
+
+def test_validation_gate_catches_broken_hier_penalty(monkeypatch):
+    """A deliberately-broken _hier_penalty must fail through the
+    production gate (maybe_validate's warning), not a bespoke assert —
+    the always-on detector for the solver's subtlest area."""
+    import jax.numpy as jnp
+
+    from blance_tpu.plan import tensor as T
+
+    def no_penalty(anchors, gids, gid_valid, rules, gids_cand=None):
+        cols = (gids_cand if gids_cand is not None else gids).shape[1]
+        return jnp.zeros((anchors.shape[0], cols), jnp.float32)
+
+    monkeypatch.setattr(T, "_hier_penalty", no_penalty)
+    # Distinctive P so the jitted solve retraces with the broken penalty
+    # instead of reusing a cached executable.
+    nodes, opts = _rack_setup(N=10, rack_size=2)
+    with pytest.warns(UserWarning, match="constraint-violating"):
+        result, _ = T.plan_next_map_tpu(
+            empty_parts(23), empty_parts(23), nodes, [], nodes,
+            M_1P_2R, opts)
+
+    # The honest solver stays silent — at ANOTHER distinctive P, because
+    # the jit cache still holds the broken-penalty executable for P=23
+    # even after monkeypatch.undo.
+    monkeypatch.undo()
+    import warnings as w
+    with w.catch_warnings():
+        w.simplefilter("error")
+        T.plan_next_map_tpu(
+            empty_parts(29), empty_parts(29), nodes, [], nodes,
+            M_1P_2R, opts)
 
 
 def test_degenerate_empty_partitions():
