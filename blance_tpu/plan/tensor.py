@@ -874,6 +874,11 @@ def _anchor_sat_np(
     return out
 
 
+# Partition-block size for the hierarchy audit: bounds its peak numpy
+# temporaries to [n_rules, _HIER_CHUNK, N] regardless of P.
+_HIER_CHUNK = 4096
+
+
 def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
     """Feasible-tier hierarchy misses: a copy counts when it sits at a
     WORSE rule tier than some still-open valid node could have achieved
@@ -883,14 +888,30 @@ def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
     on the assigned primary plus the state's earlier picks.
     Unsatisfiable rules never count: when no candidate reaches a better
     tier, the flat fallback is correct behavior (plan.go:214-220).
-    Per-anchor rule satisfaction is folded in incrementally, so each
-    state costs one [n_rules, P, N] table plus one AND per ordinal."""
+    Partitions are audited independently, so the work runs in P-blocks of
+    _HIER_CHUNK to keep peak memory flat in P (at the north-star
+    100k x 10k that is ~40 MB of bool temporaries per rule, not ~1 GB)."""
+    P = assign.shape[0]
+    total = 0
+    for lo in range(0, P, _HIER_CHUNK):
+        hi = min(lo + _HIER_CHUNK, P)
+        total += _count_hier_misses_block(
+            problem, assign[lo:hi], problem.prev[lo:hi])
+    return total
+
+
+def _count_hier_misses_block(
+    problem: DenseProblem, assign: np.ndarray, prev: np.ndarray
+) -> int:
+    """One partition block of _count_hier_misses; per-anchor rule
+    satisfaction folds in incrementally — each rule-bearing state costs
+    one [n_rules, B, N] table plus one AND per ordinal."""
     P, S, R = assign.shape
     N = problem.N
     if not any(problem.rules.get(si) for si in range(S)):
         return 0
     rows = np.arange(P)
-    top_anchor = problem.prev[:, 0, 0]
+    top_anchor = prev[:, 0, 0]
     misses = 0
     used = np.zeros((P, N), bool)  # nodes this partition already occupies
     for si in range(S):
@@ -935,7 +956,11 @@ def check_assignment(
     (unmeetable rules degrade softly to the flat fallback and do NOT
     count, like the reference's warnings, plan.go:214-235).
 
-    Pure numpy, cheap enough to run after every production solve — see
+    Pure numpy.  Below the auto-validation ceiling (_VALIDATE_AUTO_CELLS)
+    it is noise next to the solve; with an explicit
+    ``validate_assignment=True`` at larger scales the hierarchy audit
+    streams in P-blocks (bounded memory, but O(P*N) time — tens of
+    seconds at 100k x 10k, so opt in deliberately).  See the
     ``validate_assignment`` wiring in plan_next_map_tpu /
     PlannerSession.replan."""
     assign = np.asarray(assign)

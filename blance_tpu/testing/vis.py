@@ -107,8 +107,11 @@ def vis_maps(case: VisCase) -> tuple[PartitionMap, PartitionMap]:
     return prev_map, exp_map
 
 
-def run_vis_cases(cases: list[VisCase]) -> None:
-    """Plan each case and assert the golden map + warning count."""
+def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
+    """Plan each case and assert the golden map + warning count.
+
+    ``backend`` overrides every case's backend — how the golden suites
+    run against each exact planner implementation (greedy / native)."""
     for i, case in enumerate(cases):
         if case.ignore:
             continue
@@ -129,7 +132,7 @@ def run_vis_cases(cases: list[VisCase]) -> None:
             case.nodes_to_add,
             case.model,
             opts,
-            backend=case.backend,
+            backend=backend or case.backend,
         )
         cell_length = 2 if case.from_to_priority else 1
         got = {name: p.nodes_by_state for name, p in result.items()}

@@ -24,3 +24,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def planner_backends():
+    """Parametrize golden suites over the exact planner backends: the
+    Python greedy oracle and the native C++ core, which must be
+    bit-identical on every golden case (native.py's stated contract)."""
+    from blance_tpu.plan.native import native_available
+
+    return [
+        "greedy",
+        pytest.param("native", marks=pytest.mark.skipif(
+            not native_available(),
+            reason="native toolchain unavailable")),
+    ]

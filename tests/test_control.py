@@ -3,12 +3,21 @@
 weights let applications pin or steer placements.  In blance_tpu the booster
 is a PlanOptions field, not a package global."""
 
+import pytest
+
 from blance_tpu import HierarchyRule, Partition, PlanOptions, model, plan_next_map
+
+from conftest import planner_backends
 
 
 def cbgt_booster(w: int, stickiness: float) -> float:
     """The booster couchbase/cbgt installs (control_test.go:19-29)."""
     return max(float(-w), stickiness)
+
+
+# Exactly the form the native C++ core implements; the marker routes it
+# there instead of falling back to the Python greedy (plan/native.py).
+cbgt_booster.__blance_native__ = "cbgt"
 
 
 M = model(primary=(0, 1), replica=(1, 1))
@@ -18,7 +27,8 @@ def nbs(result):
     return {name: p.nodes_by_state for name, p in result.items()}
 
 
-def test_control_case1_pin_primary_to_c_replica_to_b():
+@pytest.mark.parametrize("backend", planner_backends())
+def test_control_case1_pin_primary_to_c_replica_to_b(backend):
     parts = {"X": Partition("X", {})}
     r, warnings = plan_next_map(
         {}, parts, ["a", "b", "c", "d", "e"], None, None, M,
@@ -26,12 +36,14 @@ def test_control_case1_pin_primary_to_c_replica_to_b():
             node_weights={"a": -2, "b": -1, "d": -2, "e": -2},
             node_score_booster=cbgt_booster,
         ),
+        backend=backend,
     )
     assert not warnings
     assert nbs(r) == {"X": {"primary": ["c"], "replica": ["b"]}}
 
 
-def test_control_case2_no_relocation_on_node_add():
+@pytest.mark.parametrize("backend", planner_backends())
+def test_control_case2_no_relocation_on_node_add(backend):
     parts = {
         "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
         "Y": Partition("Y", {"primary": ["b"], "replica": ["a"]}),
@@ -40,6 +52,7 @@ def test_control_case2_no_relocation_on_node_add():
     r, warnings = plan_next_map(
         {}, parts, ["a", "b"], None, ["c"], M,
         PlanOptions(node_score_booster=cbgt_booster),
+        backend=backend,
     )
     assert not warnings
     assert nbs(r) == {
@@ -49,7 +62,8 @@ def test_control_case2_no_relocation_on_node_add():
     }
 
 
-def test_control_case3_steer_new_partition():
+@pytest.mark.parametrize("backend", planner_backends())
+def test_control_case3_steer_new_partition(backend):
     parts = {
         "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
         "Y": Partition("Y", {"primary": ["b"], "replica": ["a"]}),
@@ -61,6 +75,7 @@ def test_control_case3_steer_new_partition():
             node_weights={"c": -3, "a": -1},
             node_score_booster=cbgt_booster,
         ),
+        backend=backend,
     )
     assert not warnings
     assert nbs(r) == {
@@ -70,7 +85,8 @@ def test_control_case3_steer_new_partition():
     }
 
 
-def test_control_case4_hierarchy_plus_booster():
+@pytest.mark.parametrize("backend", planner_backends())
+def test_control_case4_hierarchy_plus_booster(backend):
     prev = {"X": Partition("X", {"primary": ["a"], "replica": ["b"]})}
     parts = {
         "X": Partition("X", {"primary": ["a"], "replica": ["b"]}),
@@ -84,6 +100,7 @@ def test_control_case4_hierarchy_plus_booster():
             hierarchy_rules={"replica": [HierarchyRule(2, 1)]},
             node_score_booster=cbgt_booster,
         ),
+        backend=backend,
     )
     assert not warnings
     assert nbs(r) == {

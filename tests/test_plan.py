@@ -9,6 +9,8 @@ import pytest
 
 from blance_tpu import Partition, PartitionModelState, PlanOptions, plan_next_map
 
+from conftest import planner_backends
+
 
 def pm(d):
     """{"0": {"primary": ["a"]}} -> PartitionMap"""
@@ -212,8 +214,9 @@ CASES = [
 ]
 
 
+@pytest.mark.parametrize("backend", planner_backends())
 @pytest.mark.parametrize("case", CASES, ids=[c["about"] for c in CASES])
-def test_plan_next_map(case):
+def test_plan_next_map(case, backend):
     opts = PlanOptions(
         model_state_constraints=case.get("constraints"),
         partition_weights=case.get("pweights"),
@@ -224,7 +227,7 @@ def test_plan_next_map(case):
     )
     result, warnings = plan_next_map(
         pm(case["prev"]), pm(case["assign"]), case["nodes"],
-        case["remove"], case["add"], case["model"], opts,
+        case["remove"], case["add"], case["model"], opts, backend=backend,
     )
     got = {name: p.nodes_by_state for name, p in result.items()}
     exp = {name: dict(nbs) for name, nbs in case["exp"].items()}

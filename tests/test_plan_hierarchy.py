@@ -1,8 +1,12 @@
 """Ports of TestPlanNextMapHierarchy, TestMultiPrimary, Test2Replicas and
 TestPlanNextMapHierarchyMultiRackFailureCases (plan_test.go:2208-2863)."""
 
+import pytest
+
 from blance_tpu import HierarchyRule, model
 from blance_tpu.testing.vis import VisCase, run_vis_cases
+
+from conftest import planner_backends
 
 M_1P_1R = model(primary=(0, 1), replica=(1, 1))
 M_1P_2R = model(primary=(0, 1), replica=(1, 2))
@@ -17,8 +21,9 @@ WANT_SAME_RACK = {"replica": [HierarchyRule(include_level=1, exclude_level=0)]}
 WANT_OTHER_RACK = {"replica": [HierarchyRule(include_level=2, exclude_level=1)]}
 
 
-def test_plan_next_map_hierarchy():
-    run_vis_cases([
+@pytest.mark.parametrize("backend", planner_backends())
+def test_plan_next_map_hierarchy(backend):
+    run_vis_cases(backend=backend, cases=[
         VisCase(
             about="2 racks, but nil hierarchy rules",
             from_to=[
@@ -104,8 +109,9 @@ def test_plan_next_map_hierarchy():
     ])
 
 
-def test_multi_primary():
-    run_vis_cases([
+@pytest.mark.parametrize("backend", planner_backends())
+def test_multi_primary(backend):
+    run_vis_cases(backend=backend, cases=[
         VisCase(
             about="1 node",
             from_to=[("", "m")] * 8,
@@ -142,8 +148,9 @@ def test_multi_primary():
     ])
 
 
-def test_2_replicas():
-    run_vis_cases([
+@pytest.mark.parametrize("backend", planner_backends())
+def test_2_replicas(backend):
+    run_vis_cases(backend=backend, cases=[
         VisCase(
             about="8 partitions, 1 primary, 2 replicas, from 0 to 4 nodes",
             from_to=[
@@ -255,7 +262,8 @@ def test_2_replicas():
     ])
 
 
-def test_hierarchy_multi_rack_failure_cases():
+@pytest.mark.parametrize("backend", planner_backends())
+def test_hierarchy_multi_rack_failure_cases(backend):
     hierarchy_3x3 = {
         "a": "r0", "b": "r0", "c": "r0",
         "d": "r1", "e": "r1", "f": "r1",
@@ -271,7 +279,7 @@ def test_hierarchy_multi_rack_failure_cases():
         "a": "r0", "b": "r0", "c": "r1", "d": "r1",
         "r0": "z0", "r1": "z0",
     }
-    run_vis_cases([
+    run_vis_cases(backend=backend, cases=[
         VisCase(
             about="3 racks, 3 nodes from each rack",
             from_to=[

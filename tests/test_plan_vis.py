@@ -1,15 +1,20 @@
 """Port of TestPlanNextMapVis — flat-model golden scenarios
 (plan_test.go:1746-2205)."""
 
+import pytest
+
 from blance_tpu import model
 from blance_tpu.testing.vis import VisCase, run_vis_cases
+
+from conftest import planner_backends
 
 M_1P_0R = model(primary=(0, 1), replica=(1, 0))
 M_1P_1R = model(primary=(0, 1), replica=(1, 1))
 
 
-def test_plan_next_map_vis():
-    run_vis_cases([
+@pytest.mark.parametrize("backend", planner_backends())
+def test_plan_next_map_vis(backend):
+    run_vis_cases(backend=backend, cases=[
         VisCase(
             about="single node, simple assignment of primary",
             from_to=[("", "m"), ("", "m")],
@@ -256,6 +261,9 @@ def test_plan_next_map_vis():
             nodes=["a", "b", "c"], model=M_1P_1R,
         ),
         VisCase(
+            # Known gap carried from the reference (plan_test.go:2140-2143):
+            # "ISSUE: result does not have 2nd order of balance'd-ness" —
+            # the golden output bakes the imperfection in.
             about="8 partitions, 2 nodes add 1 node",
             from_to=[
                 ("sm", "s m"),
@@ -270,6 +278,8 @@ def test_plan_next_map_vis():
             nodes=["a", "b", "c"], model=M_1P_1R,
         ),
         VisCase(
+            # Known gap carried from the reference (plan_test.go:2160-2162):
+            # same 2nd-order balance imperfection, flipped orientation.
             about="8 partitions, 2 nodes add 1 node, flipped ms",
             from_to=[
                 ("ms", " sm"),
@@ -284,6 +294,9 @@ def test_plan_next_map_vis():
             nodes=["a", "b", "c"], model=M_1P_1R,
         ),
         VisCase(
+            # Known gap carried from the reference (plan_test.go:2181-2184):
+            # "ISSUE: not enough partitions moved: c has less than a & b,
+            # especially replicas; but it has some 2nd order balance'd-ness."
             about="8 partitions, 2 nodes add 1 node, interleaved m's",
             from_to=[
                 ("ms", " sm"),
@@ -298,6 +311,8 @@ def test_plan_next_map_vis():
             nodes=["a", "b", "c"], model=M_1P_1R,
         ),
         VisCase(
+            # Known gap carried from the reference (plan_test.go:2203-2206):
+            # same not-enough-moved imperfection, s/m interleaving flipped.
             about="8 partitions, 2 nodes add 1 node, interleaved s'm",
             from_to=[
                 ("sm", "s m"),
