@@ -10,14 +10,10 @@ from blance_tpu import HierarchyRule, Partition, PlanOptions, model, plan_next_m
 from conftest import planner_backends
 
 
-def cbgt_booster(w: int, stickiness: float) -> float:
-    """The booster couchbase/cbgt installs (control_test.go:19-29)."""
-    return max(float(-w), stickiness)
-
-
-# Exactly the form the native C++ core implements; the marker routes it
-# there instead of falling back to the Python greedy (plan/native.py).
-cbgt_booster.__blance_native__ = "cbgt"
+# The booster couchbase/cbgt installs (control_test.go:19-29); the library
+# exports it with the native-compat marker, so both the greedy and the C++
+# parametrizations exercise the exact same formula.
+from blance_tpu.plan.native import cbgt_node_score_booster as cbgt_booster
 
 
 M = model(primary=(0, 1), replica=(1, 1))

@@ -156,11 +156,13 @@ def test_shard_count_contract_invariance():
     assert np.array_equal(solve_problem_sharded(make_mesh(8), p8), a8)
 
     # Cross-operator: re-solving the 8-shard output on 1 shard may only
-    # repair residual imbalance — bounded churn, zero violations.
+    # repair residual imbalance — zero violations, churn pinned at the
+    # measured value (0/64) plus slack 2 so a regression toward the old
+    # ~10% drift surfaces here instead of passing silently.
     f1 = solve_problem_sharded(make_mesh(1), p8)
     assert _rule_violations(problem, f1) == 0
     churned = int((f1 != a8).any(axis=(1, 2)).sum())
-    assert churned <= len(parts) * 0.1, churned
+    assert churned <= 2, churned
 
 
 def test_sharded_rack_rules_zero_violations():

@@ -370,6 +370,39 @@ def test_hier_misses_counted_on_crafted_assignment():
     assert check_assignment(p4, a4)["hierarchy_misses"] == 0
 
 
+def test_auto_routing_at_real_threshold():
+    """backend="auto" with the REAL threshold (no monkeypatch): below
+    256Ki cells it must take the exact native path (bit-identical to
+    greedy), at/above it the batched tpu path — and both land at the
+    same contract on a realistic rebalance."""
+    from blance_tpu.plan.api import _AUTO_TPU_THRESHOLD
+
+    nodes = [f"n{i}" for i in range(8)]
+    parts = empty_parts(1024)  # 1024 x 8 cells: well below the threshold
+    assert len(parts) * len(nodes) < _AUTO_TPU_THRESHOLD
+    golden, gw = plan_next_map(parts, parts, nodes, [], nodes, M_1P_1R,
+                               backend="greedy")
+    got, w = plan_next_map(parts, parts, nodes, [], nodes, M_1P_1R,
+                           backend="auto")
+    assert got == golden and w == gw  # exact path, bit-identical
+
+    # At the threshold boundary: 4096 x 64 = exactly 256Ki -> tpu path.
+    nodes_big = [f"n{i}" for i in range(64)]
+    parts_big = empty_parts(4096)
+    assert len(parts_big) * len(nodes_big) >= _AUTO_TPU_THRESHOLD
+    got_big, w_big = plan_next_map(
+        parts_big, parts_big, nodes_big, [], nodes_big, M_1P_1R,
+        backend="auto")
+    assert not w_big
+    loads = {}
+    for p in got_big.values():
+        for ns in p.nodes_by_state.values():
+            for n in ns:
+                loads[n] = loads.get(n, 0) + 1
+    assert len(loads) == 64
+    assert max(loads.values()) - min(loads.values()) <= 8, loads
+
+
 def test_primary_state_rules_no_false_misses():
     """Rules on state 0 anchor on the PREVIOUS primary (the solver's
     top_anchor), never on the node being judged — a correct fresh solve
@@ -527,7 +560,12 @@ def test_replan_is_fixpoint():
 
 
 def _reencode(problem, result):
-    """PartitionMap result -> assign[P, S, R'] in the problem's id space."""
+    """PartitionMap result -> assign[P, S, R'] in the problem's id space.
+
+    Deliberately NOT encode_problem(result, result, ...): a fresh encode
+    may intern/sort partitions differently than ``problem`` did (the
+    planner sort keys off prev holders and removals), and check_assignment
+    indexes prev/constraints by THIS problem's order."""
     r_max = max([problem.R, 1] + [
         len(ns) for p in result.values() for ns in p.nodes_by_state.values()])
     assign = np.full((problem.P, problem.S, r_max), -1, np.int32)
