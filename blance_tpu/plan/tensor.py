@@ -335,6 +335,8 @@ def _assign_slot(
     init_assign: Optional[jnp.ndarray] = None,  # [P] warm-start (or -1)
     init_used: Optional[jnp.ndarray] = None,  # [N] weight behind the warm start
     node_axis: Optional[str] = None,
+    topup_share: Optional[jnp.ndarray] = None,  # [N] per-node share for
+    # capacity top-ups when rule-constrained demand exceeds the rail
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Auction: returns (slot_assign[P] int32 GLOBAL node id or -1, used[N]).
 
@@ -343,6 +345,17 @@ def _assign_slot(
     progress), repeat.  Ends when everyone is assigned or nothing moved.
     ``init_assign``/``init_used`` seed the loop with pre-pinned placements
     (the warm start); pinned partitions never rebid.
+
+    When a round accepts NOTHING while bidders remain — hierarchy rules can
+    owe one rack far more copies than the global capacity rail allots it
+    (e.g. every heavy node on one rack: the light racks then owe most
+    replicas) — the rail is raised instead of abandoned: every node gains
+    its ``topup_share`` of the remaining unassigned weight and the priced
+    rounds continue.  This keeps per-node acceptance discipline for
+    rule-constrained overflow, where the one-shot force step would herd
+    stragglers onto the locally-cheapest node (measured within-rack
+    replica spread 16..29 vs the greedy oracle's 20..21 on a weighted
+    3-rack fuzz seed; with top-up both sit at ~1).
 
     Partition axis: entirely shard-local — the caller hands each shard its
     slice of capacity and psums the returned per-node usage afterwards, so
@@ -480,6 +493,21 @@ def _assign_slot(
         used = used + used2
 
         progress = jnp.any(accept | accept2)
+        if topup_share is not None:
+            # Stalled with FEASIBLE bidders left: raise the rail by each
+            # node's share of their remaining weight and keep the priced
+            # rounds going (see docstring).  Hard-infeasible stragglers
+            # (no valid node at any price — raw_best_all >= _INF/2) must
+            # not force extra rounds: only the force step can resolve
+            # them, so without a feasible bidder the loop still exits on
+            # the first stalled round.  Share-0 (invalid) nodes get no
+            # top-up and stay closed.
+            rem_w = jnp.sum(jnp.where(
+                unassigned & (raw_best_all < _INF / 2), pweights, 0.0))
+            stalled = ~progress & (rem_w > 0)
+            topup = jnp.ceil(rem_w * topup_share)
+            rem_cap = jnp.where(stalled, rem_cap + topup, rem_cap)
+            progress = progress | (stalled & jnp.any(topup > 0))
         return (slot_assign, unassigned, rem_cap, used, progress, it + 1)
 
     def round_cond(carry):
@@ -777,7 +805,7 @@ def solve_dense(
                 return _assign_slot(
                     score, pweights, cap, 1.0 / w_div, jitter_scale,
                     axis_name, init_assign=init_assign, init_used=pin_used,
-                    node_axis=node_axis)
+                    node_axis=node_axis, topup_share=cap_share)
 
             def keep_pins(_):
                 return init_assign, pin_used

@@ -107,7 +107,7 @@ def test_2d_balance_matches_1d_contract():
     it at the measured value so balance regressions surface here."""
     problem, _, _, _ = _rack_problem()
     assign = solve_problem_sharded(make_mesh_2d(2, 4), problem)
-    for si, bound in ((0, 0), (1, 8)):  # measured: primaries 0, replicas 8
+    for si, bound in ((0, 0), (1, 6)):  # measured: primaries 0, replicas 6
         ids = assign[:, si, :].ravel()
         loads = np.bincount(ids[ids >= 0], minlength=8)
         assert loads.max() - loads.min() <= bound, (si, loads)
@@ -128,7 +128,8 @@ def test_2d_deterministic_and_own_fixpoint():
 def test_2d_cross_operator_churn_bounded():
     """Re-solving the 2x4 output on the 8-shard 1-D mesh may repair the
     parts=2 residual imbalance but must not violate rules; churn is
-    pinned at measured (12/64) + small slack."""
+    pinned at measured (17/64 with the stall top-up, which lets the
+    8-shard solve repair more of the 2-shard residual) + small slack."""
     problem, parts, m, opts = _rack_problem()
     a24 = solve_problem_sharded(make_mesh_2d(2, 4), problem)
     p2 = encode_problem({}, parts, problem.nodes, [], m, opts)
@@ -136,7 +137,7 @@ def test_2d_cross_operator_churn_bounded():
     f1 = solve_problem_sharded(make_mesh(8), p2)
     assert _rule_violations(problem, f1) == 0
     churned = int((f1 != a24).any(axis=(1, 2)).sum())
-    assert churned <= 14, churned  # measured 12 of 64
+    assert churned <= 20, churned  # measured 17 of 64
 
 
 def test_2d_node_padding():

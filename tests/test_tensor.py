@@ -601,11 +601,12 @@ def test_fuzz_contract_random_configs(seed):
     (3) keep weighted balance spread within 2x + 5 of the sequential
         greedy oracle on the same problem, and
     (4) keep delta-rebalance churn (calc_all_moves op count) within
-        1.4x + 4 of the oracle's churn for the same delta.
-    Bounds pinned from a 16-seed measurement (worst observed: spread
-    35.5 vs 23.5 on a weighted+rack seed; churn 91 vs 68) — they flag
-    regressions while acknowledging the batch solver trades a little
-    tightness for wall-clock (DESIGN.md section 7)."""
+        1.2x + 4 of the oracle's churn for the same delta.
+    Bounds pinned from a 16-seed measurement after the capacity top-up
+    fix (worst observed: spread 27.5 vs 23.5 on a weighted+rack seed —
+    mostly rule-forced structural imbalance; churn 75 vs 68) — they
+    flag regressions while acknowledging the batch solver trades a
+    little tightness for wall-clock (DESIGN.md section 7)."""
     from blance_tpu.core.encode import encode_problem
     from blance_tpu.moves.batch import calc_all_moves
 
@@ -668,7 +669,7 @@ def test_fuzz_contract_random_configs(seed):
         assert sp_t[st] <= 2 * sp_g[st] + 5, (
             f"state {st}: tpu spread {sp_t[st]} vs greedy {sp_g[st]}")
 
-    # (4) churn within 1.4x + 4 of the oracle for the same delta.
+    # (4) churn within 1.2x + 4 of the oracle for the same delta.
     churn_t = sum(len(v) for v in calc_all_moves(m1, m2, m).values())
     churn_g = sum(len(v) for v in calc_all_moves(g1, g2, m).values())
-    assert churn_t <= 1.4 * churn_g + 4, (churn_t, churn_g)
+    assert churn_t <= 1.2 * churn_g + 4, (churn_t, churn_g)
