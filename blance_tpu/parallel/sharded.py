@@ -36,7 +36,8 @@ from ..core.encode import DenseProblem
 from ..plan.tensor import solve_dense_converged
 
 __all__ = ["make_mesh", "make_mesh_2d", "make_hybrid_mesh",
-           "solve_dense_sharded", "pad_partitions", "pad_nodes"]
+           "slice_major_order", "solve_dense_sharded",
+           "pad_partitions", "pad_nodes"]
 
 PARTITION_AXIS = "parts"
 NODE_AXIS = "nodes"
@@ -50,6 +51,13 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (PARTITION_AXIS,))
 
 
+def slice_major_order(slice_ids: list) -> list:
+    """Stable slice-major device ordering: indices sorted by slice id,
+    original order preserved within a slice.  Pure so the multi-slice
+    path is unit-testable without multi-slice hardware."""
+    return sorted(range(len(slice_ids)), key=lambda i: (slice_ids[i], i))
+
+
 def make_hybrid_mesh() -> Mesh:
     """Multi-slice (multi-host) 1-D mesh, DCN-aware.
 
@@ -58,19 +66,24 @@ def make_hybrid_mesh() -> Mesh:
     XLA lowers a psum over a flat axis hierarchically when devices that
     share ICI are contiguous in the mesh, keeping the heavy intra-slice
     hops on ICI and only one reduced copy per slice on DCN.  This helper
-    orders devices slice-major (via mesh_utils when several slices are
-    visible) to guarantee that contiguity; on a single slice it is
-    equivalent to :func:`make_mesh`.
+    orders devices slice-major (stable within a slice, preserving the
+    runtime's topology order) to guarantee that contiguity; on a single
+    slice it is equivalent to :func:`make_mesh`.
+
+    Caveat: within a slice the runtime's enumeration order is trusted as
+    ICI-reasonable.  On multi-host slices where jax.devices() enumerates
+    by (process, local ordinal) but the physical torus differs,
+    jax.experimental.mesh_utils.create_hybrid_device_mesh can arrange
+    intra-slice devices by physical coordinates — worth benchmarking
+    there; this helper prefers the simple order that is provably
+    slice-contiguous and unit-testable (slice_major_order).
     """
     devices = jax.devices()
-    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
-    if n_slices > 1:
-        from jax.experimental import mesh_utils
-
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            (len(devices) // n_slices,), (n_slices,), devices=devices,
-            allow_split_physical_axes=True)
-        return Mesh(dev_array.reshape(-1), (PARTITION_AXIS,))
+    slice_ids = [getattr(d, "slice_index", 0) for d in devices]
+    if len(set(slice_ids)) > 1:
+        order = slice_major_order(slice_ids)
+        return Mesh(np.asarray([devices[i] for i in order]),
+                    (PARTITION_AXIS,))
     return make_mesh()
 
 

@@ -137,11 +137,13 @@ def _psum(x, axis_name):
 #
 # Under a 2-D mesh (parts x nodes) every [N] vector (counts, capacity,
 # prices) stays REPLICATED along the node axis — at the north-star 10k
-# nodes that's kilobytes — while every [P, N] matrix (score, penalty,
-# taken, membership) is sharded on its node axis.  Acceptance/capacity
-# logic therefore runs as identical replicated math on every node shard;
-# the only node-axis collectives are (a) combining per-row (min, argmin,
-# second) stats and (b) fetching a matrix value at a remote column.
+# nodes that's kilobytes — while the [P, N] score (and the masks fused
+# into it) holds only local columns.  Membership/exclusivity live as
+# [P, small] GLOBAL id columns compared against the local column window
+# (_member_ids), so they need no collectives at all.  Acceptance/capacity
+# logic runs as identical replicated math on every node shard; the only
+# node-axis collectives are (a) combining per-row (min, argmin, second)
+# stats and (b) fetching a matrix value at a remote column.
 
 
 def _node_off(node_axis: Optional[str], n_l: int):
@@ -157,16 +159,31 @@ def _node_slice(vec: jnp.ndarray, node_axis: Optional[str], n_l: int):
         vec, _node_off(node_axis, n_l), n_l, axis=vec.ndim - 1)
 
 
-def _membership_local(
-    ids: jnp.ndarray, n_l: int, offset
-) -> jnp.ndarray:
-    """[P, R] GLOBAL node ids -> [P, N_l] membership of local columns."""
-    p = ids.shape[0]
-    loc = ids - offset
-    ok = (ids >= 0) & (loc >= 0) & (loc < n_l)
-    out = jnp.zeros((p, n_l), jnp.bool_)
-    return out.at[jnp.arange(p)[:, None], jnp.where(ok, loc, n_l)].set(
-        True, mode="drop")
+def _member_ids(ids: jnp.ndarray, cols: jnp.ndarray) -> jnp.ndarray:
+    """[P, K] GLOBAL node ids x [N_l] global column ids -> [P, N_l]
+    membership, as K unrolled broadcast-compares ORed together.
+
+    Deliberately NOT a scatter: scatters materialize the [P, N_l] bool in
+    HBM, while compares fuse into whatever elementwise consumer follows
+    (the score build) — at the north-star scale that removes ~1 GB of
+    write+read traffic per mask.  -1 ids never match (cols >= 0), and
+    column ids are global, so the result is node-shard invariant by
+    construction."""
+    out = None
+    for k in range(ids.shape[1]):
+        m = ids[:, k][:, None] == cols[None, :]
+        out = m if out is None else (out | m)
+    if out is None:  # K == 0
+        return jnp.zeros((ids.shape[0], cols.shape[0]), jnp.bool_)
+    return out
+
+
+def _in_id_list(node: jnp.ndarray, id_list: list) -> jnp.ndarray:
+    """[P] node id -> [P] bool: held by any of the [P] id columns."""
+    out = jnp.zeros(node.shape[0], jnp.bool_)
+    for ids in id_list:
+        out = out | ((node == ids) & (node >= 0))
+    return out
 
 
 def _gather_cols(
@@ -632,9 +649,14 @@ def solve_dense(
 
     assign = jnp.full((p, s, r_max), -1, jnp.int32)
     # Nodes already holding this partition at an equal-or-higher priority
-    # state in this pass (excludeHigherPriorityNodes, plan.go:146-156);
-    # local columns only under node sharding.
-    taken = jnp.zeros((p, n_l), jnp.bool_)
+    # state in this pass (excludeHigherPriorityNodes, plan.go:146-156).
+    # Kept as a LIST of [P] global-id columns, not a [P, N] bitmap: the
+    # list stays kilobytes, membership tests become fusable compares (see
+    # _member_ids), and global ids make every test node-shard invariant
+    # with no psum gathers.
+    taken_ids: list = []
+    # Global column ids of this shard's node window (noff = 0 unsharded).
+    cols_l = jnp.arange(n_l, dtype=jnp.int32) + noff
 
     top_anchor = prev[:, 0, 0]  # previous primary, until slot (0,0) assigns
 
@@ -650,9 +672,8 @@ def solve_dense(
                            axis_name)
         total = total - state_prev
 
-        # Held this state before (local columns).
-        sticky_mask = _membership_local(prev[:, si, :], n_l, noff)
-        sticky_bonus = stickiness[:, si][:, None] * sticky_mask
+        # Held this state before (fusable compares, no scatter).
+        prev_state_ids = prev[:, si, :]  # [P, R]
 
         anchor = jnp.where(assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor) \
             if si > 0 else top_anchor
@@ -669,11 +690,9 @@ def solve_dense(
         kk = min(k, r_max)
         prev_k = prev[:, si, :kk]  # [P, kk]
         safe_k = jnp.clip(prev_k, 0, n - 1)
-        rows = jnp.arange(p)[:, None]
         taken_prev = jnp.stack(
-            [_gather_cols(taken.astype(jnp.float32), jnp.arange(p),
-                          safe_k[:, j], node_axis) > 0.5
-             for j in range(kk)], axis=1)
+            [_in_id_list(prev_k[:, j], taken_ids) for j in range(kk)],
+            axis=1)
         pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken_prev
         # An externally supplied prev map can repeat a node within one
         # state's row; only the first occurrence may pin, or both copies
@@ -728,11 +747,15 @@ def solve_dense(
             axis_name,
         )
         pins = pins_flat.reshape(p, kk)
-        # Same-partition exclusivity: later ordinals' pins must be invisible
+        # Same-partition exclusivity: later ordinals' pins must be visible
         # to earlier ordinals' auctions, or a displaced slot-0 copy could
-        # land on the node slot-1 keeps pinned.
-        taken = taken | _membership_local(
-            jnp.where(pins, prev_k, -1), n_l, noff)
+        # land on the node slot-1 keeps pinned.  Each pin column is later
+        # OVERWRITTEN by its ordinal's slot assignment (a superset: the
+        # slot result keeps every pin), so the list stays one column per
+        # slot instead of two.
+        pin_base = len(taken_ids)
+        for j in range(kk):
+            taken_ids.append(jnp.where(pins[:, j], prev_k[:, j], -1))
         if rules[si]:
             # Re-seed anchors from the capacity-trimmed pins: a trimmed pin
             # must not keep excluding its rack from the auction, while a
@@ -760,13 +783,17 @@ def solve_dense(
                 all_pinned = lax.psum(
                     (~all_pinned).astype(jnp.int32), axis_name) == 0
 
-            def run_auction(_, *, ri=ri, anchors=anchors):
+            def run_auction(_, *, ri=ri, anchors=anchors,
+                            taken_ids=tuple(taken_ids)):
                 """Score + auction + force for this slot — the expensive
                 path, skipped entirely when every copy pinned (converged
                 passes of solve_dense_converged land here for every slot,
                 so the confirming pass never touches a [P, N] tensor).
-                All [P, N_l]-shaped terms use local columns; [N] vectors
-                slice their local window on the fly."""
+                EVERY [P, N_l]-shaped term is built HERE from [P, small]
+                id columns and [N] vectors via fusable compares — lax.cond
+                evaluates closure captures eagerly, so the captures must
+                stay small; and scatter-free masks fuse into the score
+                expression instead of costing HBM round-trips."""
                 total_l = _node_slice(total, node_axis, n_l)
                 w_div_l = _node_slice(w_div, node_axis, n_l)
                 neg_boost_l = _node_slice(neg_boost, node_axis, n_l)
@@ -777,24 +804,25 @@ def solve_dense(
                 # sticky bids don't scramble ordinals and leftovers stay
                 # spread.
                 if ri < r_max:
-                    score = score - 0.01 * _membership_local(
-                        prev[:, si, ri:ri + 1], n_l, noff)
+                    score = score - 0.01 * _member_ids(
+                        prev[:, si, ri:ri + 1], cols_l)
                 score = score + jnp.maximum(
                     neg_boost_l[None, :],
                     jnp.where(neg_boost_l[None, :] > 0,
                               stickiness[:, si][:, None], 0.0))
-                score = score - sticky_bonus
+                score = score - stickiness[:, si][:, None] * _member_ids(
+                    prev_state_ids, cols_l)
                 # Per-slot rule penalty: anchored on the primary, every
                 # pinned ordinal, and every slot already assigned this
                 # state — so consecutive replicas spread across exclusion
-                # groups.  Built HERE (not outside the cond — lax.cond
-                # evaluates closure captures eagerly) so fully-pinned
-                # converged passes never materialize a [P, N] tensor; the
-                # branch captures only the small [P, 1+k] anchors.
+                # groups.
                 if rules[si]:
                     score = score + _hier_penalty(
                         anchors, gids, gid_valid, rules[si],
                         gids_cand=gids_l)
+                taken = _member_ids(
+                    jnp.stack(taken_ids, axis=1), cols_l) if taken_ids \
+                    else jnp.zeros((p, n_l), jnp.bool_)
                 score = score + _INF * (taken | ~valid_l[None, :])
 
                 # Exact ceil capacity: the binding rail that yields tight
@@ -819,8 +847,10 @@ def solve_dense(
 
             assign = assign.at[:, si, ri].set(slot_assign)
             total = total + used
-            taken = taken | _membership_local(
-                slot_assign[:, None], n_l, noff)
+            if ri < kk:
+                taken_ids[pin_base + ri] = slot_assign  # supersedes the pin
+            else:
+                taken_ids.append(slot_assign)
             if rules[si]:
                 anchors = anchors.at[:, 1 + ri].set(slot_assign)
 
