@@ -133,6 +133,102 @@ def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name else x
 
 
+def _hier_tier_at(
+    anchors: jnp.ndarray,  # [P, A] global node ids, -1 absent
+    node: jnp.ndarray,  # [P] global node ids (>= 0 assumed meaningful)
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    rules: tuple,
+) -> jnp.ndarray:
+    """_hier_penalty evaluated at ONE column per row — [P] ops only."""
+    p = node.shape[0]
+    any_anchor = jnp.any(anchors >= 0, axis=1)
+    nd = jnp.clip(node, 0, gids.shape[1] - 1)
+    pen = jnp.full(p, _RULE_MISS, jnp.float32)
+    for idx, (inc, exc) in enumerate(rules):
+        sat = jnp.ones(p, jnp.bool_)
+        for ai in range(anchors.shape[1]):
+            a = anchors[:, ai]
+            aa = jnp.maximum(a, 0)
+            inc_same = (gids[inc][aa] == gids[inc][nd]) & gid_valid[inc][aa]
+            exc_same = (gids[exc][aa] == gids[exc][nd]) & gid_valid[exc][aa]
+            sat &= jnp.where(a >= 0, inc_same & ~exc_same, True)
+        pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
+    return jnp.where(any_anchor, pen, 0.0)
+
+
+def _hier_floor_counts(
+    anchors: jnp.ndarray,  # [P, A] global node ids, -1 absent
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    valid: jnp.ndarray,  # [N] full
+    rules: tuple,
+) -> jnp.ndarray:
+    """Best attainable rule tier over valid nodes, by GROUP COUNTING.
+
+    Equivalent to ``min over valid n of _hier_penalty[:, n]`` without
+    materializing [P, N]: because each rule's exclude level is strictly
+    finer than its include level (caller checks this statically), an
+    exclude group lies inside exactly one include group, so the number
+    of rule-satisfying valid nodes is
+        count(valid in shared include group g)
+        - sum over DISTINCT anchor exclude groups of count(valid in e).
+    Everything is [N]-histograms plus [P] gathers.  Anchor-side validity
+    gates exactly like _hier_penalty: an anchor with an invalid include
+    gid makes the rule unsatisfiable; an invalid exclude gid excludes
+    nothing.  Returns the floor PENALTY value ([P], 0.0 when no anchor),
+    matching what _hier_penalty's row-min over valid columns yields —
+    with one deliberate difference: when no valid node exists at all the
+    matrix row-min is +_INF while this returns _RULE_MISS, and every
+    comparison made against the floor treats those identically (a
+    _RULE_MISS-tier pin passes either way)."""
+    p, a_width = anchors.shape
+    n = gids.shape[1]
+    any_anchor = jnp.any(anchors >= 0, axis=1)
+    floor = jnp.full(p, _RULE_MISS, jnp.float32)
+    for idx, (inc, exc) in enumerate(rules):
+        # Valid-node histograms per group at each level (group ids are
+        # dense per level, < N; invalid slots route to the drop bucket).
+        gi = jnp.where(valid, gids[inc], -1)
+        ge = jnp.where(valid, gids[exc], -1)
+        cnt_inc = jnp.zeros(n, jnp.float32).at[
+            jnp.where(gi >= 0, gi, n)].add(1.0, mode="drop")
+        cnt_exc = jnp.zeros(n, jnp.float32).at[
+            jnp.where(ge >= 0, ge, n)].add(1.0, mode="drop")
+
+        # Shared include group across present anchors (else unsatisfiable).
+        g = jnp.full(p, -1, jnp.int32)
+        ok = jnp.ones(p, jnp.bool_)
+        for ai in range(a_width):
+            a = anchors[:, ai]
+            aa = jnp.maximum(a, 0)
+            a_g = jnp.where(gid_valid[inc][aa], gids[inc][aa], -2)
+            present = a >= 0
+            ok &= jnp.where(present & (g >= 0), a_g == g, True)
+            ok &= jnp.where(present & (g < 0), a_g >= 0, True)
+            g = jnp.where(present & (g < 0), a_g, g)
+
+        # Exclusion mass: distinct exclude groups among present anchors.
+        excl = jnp.zeros(p, jnp.float32)
+        e_seen = []
+        for ai in range(a_width):
+            a = anchors[:, ai]
+            aa = jnp.maximum(a, 0)
+            e = jnp.where((a >= 0) & gid_valid[exc][aa], gids[exc][aa], -1)
+            dup = jnp.zeros(p, jnp.bool_)
+            for prev_e in e_seen:
+                dup |= (e == prev_e) & (e >= 0)
+            excl += jnp.where(
+                (e >= 0) & ~dup, cnt_exc[jnp.clip(e, 0, n - 1)], 0.0)
+            e_seen.append(e)
+
+        count = jnp.where(
+            ok & (g >= 0), cnt_inc[jnp.clip(g, 0, n - 1)] - excl, 0.0)
+        floor = jnp.where(count > 0,
+                          jnp.minimum(floor, idx * _RULE_TIER), floor)
+    return jnp.where(any_anchor, floor, 0.0)
+
+
 # --- node-axis sharding helpers ------------------------------------------
 #
 # Under a 2-D mesh (parts x nodes) every [N] vector (counts, capacity,
@@ -723,15 +819,29 @@ def solve_dense(
             # steers the displaced copy back to its own node in the auction,
             # so the corner costs at most one extra converge pass, never a
             # rule violation.
+            # Exclude groups nest inside include groups whenever the rule's
+            # exclude level is strictly finer (the normal tree shape), and
+            # then the attainable-tier floor reduces to group counting —
+            # [P] gathers instead of a [P, N] penalty matrix + row-min.
+            # Exotic rules (exc >= inc) keep the matrix path.
+            counts_ok = all(exc < inc for (inc, exc) in rules[si])
             rows1 = jnp.arange(p)
             for j in range(kk):
-                hier_j = _hier_penalty(
-                    anchors[:, :1 + j], gids, gid_valid, rules[si],
-                    gids_cand=gids_l)
-                floor_j = _row_min_global(
-                    jnp.where(valid_l[None, :], hier_j, _INF), node_axis)
-                hier_at_prev = _gather_cols(
-                    hier_j, rows1, safe_k[:, j], node_axis)
+                if counts_ok:
+                    floor_j = _hier_floor_counts(
+                        anchors[:, :1 + j], gids, gid_valid, valid,
+                        rules[si])
+                    hier_at_prev = _hier_tier_at(
+                        anchors[:, :1 + j], safe_k[:, j], gids, gid_valid,
+                        rules[si])
+                else:
+                    hier_j = _hier_penalty(
+                        anchors[:, :1 + j], gids, gid_valid, rules[si],
+                        gids_cand=gids_l)
+                    floor_j = _row_min_global(
+                        jnp.where(valid_l[None, :], hier_j, _INF), node_axis)
+                    hier_at_prev = _gather_cols(
+                        hier_j, rows1, safe_k[:, j], node_axis)
                 ok_j = pin_ok_k[:, j] & (
                     hier_at_prev < floor_j + _RULE_TIER * 0.5)
                 pin_ok_k = pin_ok_k.at[:, j].set(ok_j)

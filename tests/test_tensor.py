@@ -370,6 +370,57 @@ def test_hier_misses_counted_on_crafted_assignment():
     assert check_assignment(p4, a4)["hierarchy_misses"] == 0
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_hier_floor_counts_matches_matrix(seed):
+    """The group-counting pin floor must equal the [P, N] penalty matrix
+    row-min over valid nodes for nested (exc < inc) rules, across random
+    hierarchies, anchor sets, and validity masks."""
+    import jax.numpy as jnp
+
+    from blance_tpu.plan.tensor import (
+        _INF, _RULE_MISS, _hier_floor_counts, _hier_penalty, _hier_tier_at)
+
+    rng = np.random.default_rng(seed)
+    N = int(rng.integers(4, 30))
+    P = int(rng.integers(2, 40))
+    A = int(rng.integers(1, 4))
+    # True tree nesting with MULTIPLE zones each holding multi-node racks
+    # — the shape where a cross-include-group count leak would show.
+    racks = int(rng.integers(2, 7))
+    zones = int(rng.integers(2, 4))
+    rack_of = rng.integers(0, racks, N).astype(np.int32)
+    zone_of_rack = rng.integers(0, zones, racks).astype(np.int32)
+    gids = np.stack([
+        np.arange(N, dtype=np.int32),
+        rack_of,
+        zone_of_rack[rack_of],
+    ])
+    gid_valid = rng.random((3, N)) < 0.9
+    valid = rng.random(N) < 0.8
+    anchors = rng.integers(-1, N, (P, A)).astype(np.int32)
+    rules = ((2, 1), (1, 0)) if rng.random() < 0.5 else ((2, 1),)
+
+    pen = np.asarray(_hier_penalty(
+        jnp.asarray(anchors), jnp.asarray(gids), jnp.asarray(gid_valid),
+        rules))
+    floor_matrix = np.where(valid[None, :], pen, _INF).min(axis=1)
+    floor_counts = np.asarray(_hier_floor_counts(
+        jnp.asarray(anchors), jnp.asarray(gids), jnp.asarray(gid_valid),
+        jnp.asarray(valid), rules))
+    # The two encodings agree except the no-valid-node corner, where the
+    # matrix says +INF and the counts say RULE_MISS — both compare
+    # identically in the pin test (see _hier_floor_counts docstring).
+    fm = np.minimum(floor_matrix, _RULE_MISS)
+    assert np.array_equal(fm, floor_counts), (fm, floor_counts)
+
+    # And the single-column tier evaluator matches the matrix column.
+    node = rng.integers(0, N, P).astype(np.int32)
+    at = np.asarray(_hier_tier_at(
+        jnp.asarray(anchors), jnp.asarray(node), jnp.asarray(gids),
+        jnp.asarray(gid_valid), rules))
+    assert np.array_equal(at, pen[np.arange(P), node])
+
+
 def test_auto_routing_at_real_threshold():
     """backend="auto" with the REAL threshold (no monkeypatch): below
     256Ki cells it must take the exact native path (bit-identical to
