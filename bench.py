@@ -251,6 +251,29 @@ def main():
         CONFIGS = [(512, 64, False), (512, 128, True)]
         RUNS = 3
 
+    # Fail fast if the device runtime is wedged: a hung tunnel makes
+    # jax.devices() block forever inside native code (no Python timeout
+    # can interrupt it), so probe it in a subprocess first.  Smoke runs
+    # skip the probe: their callers select the CPU platform through
+    # jax.config.update BEFORE exec'ing this file (the env var alone
+    # doesn't work — the axon plugin overrides JAX_PLATFORMS), and that
+    # in-process pin cannot propagate to a probe subprocess, which would
+    # then hang against the very runtime smoke mode exists to avoid.
+    if not args.smoke:
+        import subprocess
+
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=240, check=True, capture_output=True)
+        except subprocess.TimeoutExpired:
+            log("FATAL: jax.devices() did not return within 240s — device "
+                "runtime unreachable; aborting instead of hanging the driver")
+            sys.exit(3)
+        except subprocess.CalledProcessError as e:
+            log(f"FATAL: device probe failed: {e.stderr.decode()[-500:]}")
+            sys.exit(3)
+
     import jax
 
     log(f"devices: {jax.devices()}")

@@ -82,6 +82,30 @@ def _scatter_counts(ids: jnp.ndarray, weights: jnp.ndarray, n: int) -> jnp.ndarr
 
 
 
+def _anchor_rule_sat(
+    anchor: jnp.ndarray,  # [P] global node ids, -1 = absent
+    cand_inc: jnp.ndarray,  # candidates' include-level gids, [P] or [1, N_l]
+    cand_exc: jnp.ndarray,  # candidates' exclude-level gids, same shape
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    inc: int,
+    exc: int,
+) -> jnp.ndarray:
+    """Rule gate for ONE anchor column: candidate satisfies (inc, exc)
+    iff it shares the anchor's include-level ancestor and NOT its
+    exclude-level ancestor; absent anchors satisfy everything; validity
+    gates on the anchor side only.  THE single spelling of the gate —
+    both the [P, N] penalty matrix and the [P] point evaluation go
+    through here, so the semantics cannot drift apart."""
+    aa = jnp.maximum(anchor, 0)
+    sh = (anchor.shape[0],) + (1,) * (cand_inc.ndim - 1)
+    inc_same = (gids[inc][aa].reshape(sh) == cand_inc) & \
+        gid_valid[inc][aa].reshape(sh)
+    exc_same = (gids[exc][aa].reshape(sh) == cand_exc) & \
+        gid_valid[exc][aa].reshape(sh)
+    return jnp.where((anchor >= 0).reshape(sh), inc_same & ~exc_same, True)
+
+
 def _hier_penalty(
     anchors: jnp.ndarray,  # [P, A] GLOBAL node ids, -1 = absent anchor
     gids: jnp.ndarray,  # [L, N] full (anchor lookups are global)
@@ -118,13 +142,9 @@ def _hier_penalty(
     for idx, (inc, exc) in enumerate(rules):
         sat = jnp.ones((p, n_l), jnp.bool_)
         for ai in range(a_width):
-            anc = anchors[:, ai]
-            aa = jnp.maximum(anc, 0)
-            inc_same = (gids[inc][aa][:, None] == gids_cand[inc][None, :]) & \
-                gid_valid[inc][aa][:, None]
-            exc_same = (gids[exc][aa][:, None] == gids_cand[exc][None, :]) & \
-                gid_valid[exc][aa][:, None]
-            sat &= jnp.where((anc >= 0)[:, None], inc_same & ~exc_same, True)
+            sat &= _anchor_rule_sat(
+                anchors[:, ai], gids_cand[inc][None, :],
+                gids_cand[exc][None, :], gids, gid_valid, inc, exc)
         pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
     return jnp.where(any_anchor[:, None], pen, 0.0)
 
@@ -148,11 +168,9 @@ def _hier_tier_at(
     for idx, (inc, exc) in enumerate(rules):
         sat = jnp.ones(p, jnp.bool_)
         for ai in range(anchors.shape[1]):
-            a = anchors[:, ai]
-            aa = jnp.maximum(a, 0)
-            inc_same = (gids[inc][aa] == gids[inc][nd]) & gid_valid[inc][aa]
-            exc_same = (gids[exc][aa] == gids[exc][nd]) & gid_valid[exc][aa]
-            sat &= jnp.where(a >= 0, inc_same & ~exc_same, True)
+            sat &= _anchor_rule_sat(
+                anchors[:, ai], gids[inc][nd], gids[exc][nd],
+                gids, gid_valid, inc, exc)
         pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
     return jnp.where(any_anchor, pen, 0.0)
 
