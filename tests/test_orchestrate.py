@@ -436,6 +436,11 @@ MOVES_CASES = [
                        ("01", "c", "replica"), ("01", "a", "")]},
     ),
     dict(
+        # The reference marks this case intermittent (a goroutine race,
+        # orchestrate_test.go:1455-1459, TODO-level known gap).  Here it
+        # runs deterministically: the asyncio orchestrator serializes on
+        # one loop, so the MoveOpWeight inner branch it was written to
+        # cover is hit every run.
         label="concurrent moves on b, 2 partitions",
         nodes=["a", "b", "c"],
         beg={"00": {"primary": ["b"], "replica": ["a"]},
