@@ -468,6 +468,9 @@ def _assign_slot(
     node_axis: Optional[str] = None,
     topup_share: Optional[jnp.ndarray] = None,  # [N] per-node share for
     # capacity top-ups when rule-constrained demand exceeds the rail
+    has_rules: bool = True,  # static: state carries hierarchy rules
+    feasible_hint: Optional[jnp.ndarray] = None,  # [P] bool, required when
+    # has_rules=False and topup_share is set: any allowed node exists
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Auction: returns (slot_assign[P] int32 GLOBAL node id or -1, used[N]).
 
@@ -515,8 +518,17 @@ def _assign_slot(
     # Loop-invariant: phase B consults the unpriced per-row best to decide
     # whether a straggler still has rule-satisfying options.  Computed once
     # here — XLA cannot hoist a [P, N] reduction out of the while_loop body
-    # on its own.
-    raw_best_all = _row_min_global(score, node_axis)
+    # on its own.  Rule-LESS states have no tiers to reason about (the
+    # boost term is a preference, not a constraint), so their gates are
+    # structurally pass-through and this whole [P, N] pass is skipped;
+    # hard feasibility then comes from the caller's id-column count
+    # (feasible_hint) instead of a row-min.
+    if has_rules:
+        raw_best_all = _row_min_global(score, node_axis)
+        hard_feasible = raw_best_all < _INF / 2
+    else:
+        raw_best_all = None
+        hard_feasible = feasible_hint
 
     def _priced_min2(price_vec):
         """Local fused min2 over this shard's columns + global combine:
@@ -553,8 +565,8 @@ def _assign_slot(
         # it: wait for capacity-ignoring force, which prefers the
         # satisfying nodes (rule conformance beats balance, like the
         # reference's hierarchy-pass-first ordering, plan.go:174-226).
-        rule_ok = (raw_choice < _RULE_MISS / 2) | \
-            (raw_best_all >= _RULE_MISS / 2)
+        rule_ok = ((raw_choice < _RULE_MISS / 2)
+                   | (raw_best_all >= _RULE_MISS / 2)) if has_rules else True
         active = unassigned & (best < _INF / 2) & rule_ok
 
         # Sort bidders by (node, urgency desc) via two stable argsorts —
@@ -608,9 +620,10 @@ def _assign_slot(
         choice2 = node_order[jnp.clip(pos, 0, n - 1)]
 
         raw2 = _gather_cols(score, sperm, choice2, node_axis)
-        raw_best = raw_best_all[sperm]
         hard_ok = raw2 < _INF / 2
-        soft_ok = (raw2 < _RULE_MISS / 2) | (raw_best >= _RULE_MISS / 2)
+        soft_ok = ((raw2 < _RULE_MISS / 2)
+                   | (raw_best_all[sperm] >= _RULE_MISS / 2)) \
+            if has_rules else True
         accept2_s = s_mask & in_range & hard_ok & soft_ok
 
         accept2 = jnp.zeros(p, jnp.bool_).at[sperm].set(accept2_s)
@@ -634,7 +647,7 @@ def _assign_slot(
             # the first stalled round.  Share-0 (invalid) nodes get no
             # top-up and stay closed.
             rem_w = jnp.sum(jnp.where(
-                unassigned & (raw_best_all < _INF / 2), pweights, 0.0))
+                unassigned & hard_feasible, pweights, 0.0))
             stalled = ~progress & (rem_w > 0)
             topup = jnp.ceil(rem_w * topup_share)
             rem_cap = jnp.where(stalled, rem_cap + topup, rem_cap)
@@ -679,15 +692,32 @@ def _assign_slot(
     # ignoring capacity (constraint satisfaction beats balance).  Price on
     # the GLOBAL usage (one [N] psum): each shard's force sees every
     # shard's accepted weight, or all shards would pile their stragglers
-    # onto the same locally-cheapest node.
+    # onto the same locally-cheapest node.  Skipped entirely (a full
+    # [P, N] pass saved) when the rounds assigned everyone — the common
+    # case.  The psum runs unconditionally; inside the branch only
+    # node-axis collectives can occur, and ``unassigned`` is replicated
+    # along the node axis, so every participant of those collectives
+    # agrees on the branch.
     used_global = _psum(used, axis_name)
-    best, choice, _second, _raw = _priced_min2(used_global * price_scale)
-    feasible = best < _INF / 2
-    forced = unassigned & feasible
-    slot_assign = jnp.where(forced, choice, slot_assign)
-    used_forced = jnp.zeros(n, jnp.float32).at[choice].add(
-        jnp.where(forced, pweights, 0.0))
-    used = used + used_forced
+
+    def do_force(args):
+        slot_assign, unassigned, used = args
+        best, choice, _second, _raw = _priced_min2(
+            used_global * price_scale)
+        feasible = best < _INF / 2
+        forced = unassigned & feasible
+        slot_assign = jnp.where(forced, choice, slot_assign)
+        used_forced = jnp.zeros(n, jnp.float32).at[choice].add(
+            jnp.where(forced, pweights, 0.0))
+        return slot_assign, used + used_forced
+
+    def skip_force(args):
+        slot_assign, _unassigned, used = args
+        return slot_assign, used
+
+    slot_assign, used = lax.cond(
+        jnp.any(unassigned), do_force, skip_force,
+        (slot_assign, unassigned, used))
 
     return slot_assign, used
 
@@ -953,6 +983,21 @@ def solve_dense(
                     else jnp.zeros((p, n_l), jnp.bool_)
                 score = score + _INF * (taken | ~valid_l[None, :])
 
+                if rules[si]:
+                    feasible_hint = None
+                else:
+                    # Rule-less hard feasibility without a [P, N] row-min:
+                    # the taken ids are distinct per partition (exclusivity
+                    # invariant), so an allowed node exists iff the count
+                    # of taken VALID nodes is below the valid-node total.
+                    n_valid_total = jnp.sum(valid.astype(jnp.int32))
+                    tkn = jnp.zeros(p, jnp.int32)
+                    for tid in taken_ids:
+                        tkn += ((tid >= 0)
+                                & valid[jnp.clip(tid, 0, n - 1)]
+                                ).astype(jnp.int32)
+                    feasible_hint = tkn < n_valid_total
+
                 # Exact ceil capacity: the binding rail that yields tight
                 # balance; exclusivity stragglers rebid under the in-slot
                 # price and, in the worst case, the force step places them.
@@ -961,7 +1006,8 @@ def solve_dense(
                 return _assign_slot(
                     score, pweights, cap, 1.0 / w_div, jitter_scale,
                     axis_name, init_assign=init_assign, init_used=pin_used,
-                    node_axis=node_axis, topup_share=cap_share)
+                    node_axis=node_axis, topup_share=cap_share,
+                    has_rules=bool(rules[si]), feasible_hint=feasible_hint)
 
             def keep_pins(_):
                 return init_assign, pin_used
