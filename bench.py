@@ -110,7 +110,7 @@ def verify_pallas(N, seed=7):
     return True, bool(ok)
 
 
-def bench_tpu(P, N):
+def bench_tpu(P, N, fused=False):
     """On-device converged solve: compile + RUNS timed runs + audit."""
     import jax.numpy as jnp
     from blance_tpu.plan.tensor import solve_dense_converged
@@ -119,29 +119,32 @@ def bench_tpu(P, N):
      constraints, rules) = build_dense(P, N)
     dev_args = [jnp.asarray(a) for a in
                 (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+    mode = "on" if fused else "off"
+    tag = f"[{P}x{N}{' fused' if fused else ''}]"
 
     # block_until_ready is unreliable on the experimental axon platform, so
     # force completion with a small host copy ([P] primaries).
     def run():
-        out = solve_dense_converged(*dev_args, constraints, rules)
+        out = solve_dense_converged(*dev_args, constraints, rules,
+                                    fused_score=mode)
         np.asarray(out[:, 0, 0])
         return out
 
     t0 = time.perf_counter()
     out = run()
     compile_s = time.perf_counter() - t0
-    log(f"[{P}x{N}] compile+first-run: {compile_s:.2f}s")
+    log(f"{tag} compile+first-run: {compile_s:.2f}s")
 
     times = []
     for _ in range(RUNS):
         t0 = time.perf_counter()
         out = run()
         times.append(time.perf_counter() - t0)
-    log(f"[{P}x{N}] on-device solve: min {min(times)*1000:.1f}ms  runs: "
+    log(f"{tag} on-device solve: min {min(times)*1000:.1f}ms  runs: "
         f"{[f'{t*1000:.1f}' for t in times]}")
 
     counts = audit(out, valid, gids)
-    log(f"[{P}x{N}] audit: {counts}")
+    log(f"{tag} audit: {counts}")
     assert counts["unassigned_slots"] == 0
     assert counts["on_removed_nodes"] == 0
     return {
@@ -151,6 +154,42 @@ def bench_tpu(P, N):
         "solve_ms_runs": [round(t * 1000, 2) for t in times],
         "violations": counts,
     }
+
+
+def verify_fused_engine():
+    """Contract-check the COMPILED fused score engine against the matrix
+    engine on device at small scale: both audits clean, per-node load
+    spread within +2.  Gates whether fused timed runs happen at all."""
+    import jax.numpy as jnp
+    from blance_tpu.ops.reduce2 import pallas_available
+    from blance_tpu.plan.tensor import solve_dense_converged
+
+    if not pallas_available():
+        return False
+    P, N = 4096, 512
+    (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+     constraints, rules) = build_dense(P, N, seed=3)
+    dev = [jnp.asarray(a) for a in
+           (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+    outs = {}
+    for mode in ("off", "on"):
+        a = np.asarray(solve_dense_converged(
+            *dev, constraints, rules, fused_score=mode))
+        counts = audit(a, valid, gids)
+        if any(counts.values()):
+            log(f"fused-engine verify: mode={mode} violations {counts}")
+            return False
+        outs[mode] = a
+    spreads = {}
+    for mode, a in outs.items():
+        ids = a[a >= 0]
+        loads = np.bincount(ids, minlength=N)[valid]
+        spreads[mode] = int(loads.max() - loads.min())
+    ok = spreads["on"] <= spreads["off"] + 2
+    log(f"fused-engine verify @ {P}x{N}: clean audits, spreads "
+        f"matrix={spreads['off']} fused={spreads['on']} -> "
+        f"{'OK' if ok else 'REJECTED'}")
+    return ok
 
 
 def _make_map(P, N, seed=0):
@@ -279,15 +318,39 @@ def main():
     log(f"devices: {jax.devices()}")
     pallas, pallas_ok = verify_pallas(CONFIGS[-1][1])
 
+    fused_ok = not args.smoke and verify_fused_engine()
+
     detail = {"configs": [], "pallas": pallas, "pallas_verified": pallas_ok,
+              "fused_engine_verified": fused_ok,
               "device": str(jax.devices()[0]), "jax": jax.__version__,
               "runs_per_config": RUNS}
     headline = None
     for P, N, is_headline in CONFIGS:
         entry = {"P": P, "N": N}
         entry.update(bench_tpu(P, N))
+        entry["engine"] = "matrix"
+        if fused_ok:
+            fused_res = bench_tpu(P, N, fused=True)
+            entry["fused"] = fused_res
+            if fused_res["solve_ms_min"] < entry["solve_ms_min"] and \
+                    not any(fused_res["violations"].values()):
+                # Both engines are production-selectable
+                # (set_fused_score_default); report the better one as the
+                # headline and name it.
+                entry.update({k: fused_res[k] for k in
+                              ("compile_s", "solve_ms_min",
+                               "solve_ms_median", "solve_ms_runs",
+                               "violations")})
+                entry["engine"] = "fused"
         entry.update(bench_cpu(P, N))
-        entry["phases_ms"] = bench_phases(P, N)
+        # End-to-end phases through the same engine as the headline solve.
+        from blance_tpu.plan.tensor import set_fused_score_default
+
+        set_fused_score_default("on" if entry["engine"] == "fused" else "off")
+        try:
+            entry["phases_ms"] = bench_phases(P, N)
+        finally:
+            set_fused_score_default("off")
         entry["vs_baseline"] = round(
             entry["cpu_s"] * 1000 / entry["solve_ms_min"], 1)
         detail["configs"].append(entry)

@@ -51,6 +51,12 @@ from ..ops.reduce2 import (
     pallas_available,
     priced_min2_argmin,
 )
+from ..ops.score_fused import (
+    fused_score_min2,
+    jitter_hash,
+    pack_score_inputs,
+    score_at_columns,
+)
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
            "check_assignment", "maybe_validate"]
@@ -63,6 +69,24 @@ _MAX_AUCTION_ROUNDS = 16
 # below every decision-bearing term (stickiness >= 1.5 typical, rule tiers
 # 1e4, price >= 1/node-weight per accepted unit).
 _JITTER = 1.0e-5
+
+# Score-engine default for plan_next_map_tpu: "off" materializes the
+# [P, N_l] score matrix per slot; "on" computes the score inside the
+# Pallas reduction kernel (ops/score_fused.py) so the matrix never
+# exists; "interpret" runs the fused kernel under the pallas interpreter
+# (CPU testing).  Passed into the jit as a static arg, so flipping the
+# default takes effect on the next call.  Conservative default: the
+# fused path is enabled where it has been verified on the device (see
+# bench.py's fused-vs-matrix check).
+_FUSED_SCORE_DEFAULT = "off"
+
+
+def set_fused_score_default(mode: str) -> None:
+    """Select the score engine for subsequent plan_next_map_tpu calls."""
+    global _FUSED_SCORE_DEFAULT
+    if mode not in ("off", "on", "interpret"):
+        raise ValueError(f"unknown fused-score mode: {mode!r}")
+    _FUSED_SCORE_DEFAULT = mode
 
 
 def _drop_empty(ids: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -457,11 +481,12 @@ def _pin_prev_holders(
 
 
 def _assign_slot(
-    score: jnp.ndarray,  # [P, N_local] (forbidden already folded in as +_INF)
+    min2_fn,  # price_vec[N] -> (best, choice GLOBAL, second, raw-at-choice)
+    score_at_fn,  # (rows[K], cols_global[K]) -> unpriced score values [K]
+    p: int,
     pweights: jnp.ndarray,  # [P]
     cap: jnp.ndarray,  # [N] weighted capacity for this slot (global)
     price_scale: jnp.ndarray,  # [N] converts accepted weight into score units
-    jitter_scale: jnp.ndarray,  # scalar, <= half the smallest real delta
     axis_name: Optional[str],
     init_assign: Optional[jnp.ndarray] = None,  # [P] warm-start (or -1)
     init_used: Optional[jnp.ndarray] = None,  # [N] weight behind the warm start
@@ -473,6 +498,13 @@ def _assign_slot(
     # has_rules=False and topup_share is set: any allowed node exists
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Auction: returns (slot_assign[P] int32 GLOBAL node id or -1, used[N]).
+
+    The score is reached ONLY through the two callables, so the caller
+    chooses the engine: a materialized [P, N_l] matrix (min2_fn = the
+    priced Pallas reduction or the XLA reference over it), or the fused
+    in-kernel score (ops/score_fused.py) where the matrix never exists.
+    Both must include the deterministic tie-break jitter and fold
+    forbidden columns in as +_INF.
 
     Each round: bid on the best open node, accept most-urgent bidders up to
     remaining capacity (at least the first bidder per node, to guarantee
@@ -493,56 +525,29 @@ def _assign_slot(
 
     Partition axis: entirely shard-local — the caller hands each shard its
     slice of capacity and psums the returned per-node usage afterwards, so
-    shards may take different round counts.  Node axis: ``score`` holds
+    shards may take different round counts.  Node axis: the callables see
     only this shard's columns while cap/price/used stay replicated [N];
-    each round runs one all_gather (per-row min stats) and one masked psum
-    (remote column reads) over ``node_axis`` — everything else is
-    identical replicated math on every node shard.
+    each round runs one all_gather (per-row min stats) inside min2_fn —
+    everything else is identical replicated math on every node shard.
     """
-    p, n_l = score.shape
     n = cap.shape[0]
-    noff = _node_off(node_axis, n_l)
-
-    # Deterministic tie-break jitter (Weyl-style hash of (partition, node))
-    # so equal-score bids spread over equal nodes instead of herding.  The
-    # hash uses GLOBAL partition and node indices — shard-local indices
-    # would make every shard bid on the same jitter-preferred columns in
-    # lockstep (and break node-shard-count invariance of the hash).
-    base = lax.axis_index(axis_name) * p if axis_name else 0
-    pi = (base + jnp.arange(p, dtype=jnp.uint32))[:, None].astype(jnp.uint32)
-    ni = (jnp.uint32(noff) + jnp.arange(n_l, dtype=jnp.uint32))[None, :]
-    jitter = ((pi * jnp.uint32(2654435761) + ni * jnp.uint32(40503))
-              & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
-    score = score + jitter_scale * jitter
 
     # Loop-invariant: phase B consults the unpriced per-row best to decide
     # whether a straggler still has rule-satisfying options.  Computed once
-    # here — XLA cannot hoist a [P, N] reduction out of the while_loop body
-    # on its own.  Rule-LESS states have no tiers to reason about (the
-    # boost term is a preference, not a constraint), so their gates are
-    # structurally pass-through and this whole [P, N] pass is skipped;
-    # hard feasibility then comes from the caller's id-column count
-    # (feasible_hint) instead of a row-min.
+    # here (min2 at price 0) — XLA cannot hoist a [P, N] reduction out of
+    # the while_loop body on its own.  Rule-LESS states have no tiers to
+    # reason about (the boost term is a preference, not a constraint), so
+    # their gates are structurally pass-through and this whole pass is
+    # skipped; hard feasibility then comes from the caller's id-column
+    # count (feasible_hint) instead of a row-min.
     if has_rules:
-        raw_best_all = _row_min_global(score, node_axis)
+        raw_best_all, _, _, _ = min2_fn(jnp.zeros(n, jnp.float32))
         hard_feasible = raw_best_all < _INF / 2
     else:
         raw_best_all = None
         hard_feasible = feasible_hint
 
-    def _priced_min2(price_vec):
-        """Local fused min2 over this shard's columns + global combine:
-        returns (best, choice[global id], second, raw-at-choice), identical
-        on every node shard."""
-        price_l = _node_slice(price_vec, node_axis, n_l)
-        if pallas_available():
-            best_l, choice_l, second_l = priced_min2_argmin(score, price_l)
-        else:
-            best_l, choice_l, second_l = min2_argmin_reference(
-                score + price_l[None, :])
-        raw_l = jnp.take_along_axis(score, choice_l[:, None], axis=1)[:, 0]
-        return _combine_min2(
-            best_l, choice_l + noff, second_l, raw_l, node_axis)
+    _priced_min2 = min2_fn
 
     def round_body(carry):
         slot_assign, unassigned, rem_cap, used, _progress, it = carry
@@ -619,7 +624,7 @@ def _assign_slot(
         in_range = pos < n
         choice2 = node_order[jnp.clip(pos, 0, n - 1)]
 
-        raw2 = _gather_cols(score, sperm, choice2, node_axis)
+        raw2 = score_at_fn(sperm, choice2)
         hard_ok = raw2 < _INF / 2
         soft_ok = ((raw2 < _RULE_MISS / 2)
                    | (raw_best_all[sperm] >= _RULE_MISS / 2)) \
@@ -723,7 +728,8 @@ def _assign_slot(
 
 
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
-                                   "node_axis", "node_shards"))
+                                   "node_axis", "node_shards",
+                                   "fused_score"))
 def solve_dense(
     prev: jnp.ndarray,  # [P, S, R] int32 (GLOBAL node ids)
     pweights: jnp.ndarray,  # [P] float32
@@ -737,6 +743,9 @@ def solve_dense(
     axis_name: Optional[str] = None,  # static; set under shard_map
     node_axis: Optional[str] = None,  # static; second mesh axis over nodes
     node_shards: int = 1,  # static; size of the node axis (N must divide)
+    fused_score: str = "off",  # static; "off" = materialized score matrix,
+    # "on" = in-kernel score (ops/score_fused.py, TPU), "interpret" =
+    # in-kernel via the pallas interpreter (CPU tests)
 ) -> jnp.ndarray:
     """Solve the whole placement problem on device; returns assign[P, S, R].
 
@@ -947,41 +956,109 @@ def solve_dense(
                 path, skipped entirely when every copy pinned (converged
                 passes of solve_dense_converged land here for every slot,
                 so the confirming pass never touches a [P, N] tensor).
-                EVERY [P, N_l]-shaped term is built HERE from [P, small]
-                id columns and [N] vectors via fusable compares — lax.cond
-                evaluates closure captures eagerly, so the captures must
-                stay small; and scatter-free masks fuse into the score
-                expression instead of costing HBM round-trips."""
+                Two engines behind _assign_slot's callables: the default
+                MATRIX path builds score[P, N_l] from fusable compares
+                (scatter-free — the compares fuse into the elementwise
+                build) and reduces it with the priced Pallas kernel; the
+                FUSED path (ops/score_fused.py) computes the score
+                in-kernel from the same id columns, so the matrix never
+                exists and every round's HBM traffic is O(P + N)."""
                 total_l = _node_slice(total, node_axis, n_l)
                 w_div_l = _node_slice(w_div, node_axis, n_l)
                 neg_boost_l = _node_slice(neg_boost, node_axis, n_l)
-                balance = 0.001 * total_l[None, :] / jnp.maximum(total_p, 1.0)
-                score = balance / w_div_l[None, :]
-                # Same-ordinal alignment: slot ri mildly prefers prev slot
-                # ri's node (above jitter, below every real term), so
-                # sticky bids don't scramble ordinals and leftovers stay
-                # spread.
-                if ri < r_max:
+                stick_si = stickiness[:, si]
+                prev_slot = prev[:, si, ri] if ri < r_max else \
+                    jnp.full(p, -1, jnp.int32)
+                pbase = lax.axis_index(axis_name) * p if axis_name else 0
+                anchors_k = anchors if rules[si] else \
+                    jnp.full((p, 1), -1, jnp.int32)
+
+                if fused_score != "off":
+                    si_pack = pack_score_inputs(
+                        total_l=total_l, total_p=total_p, w_div_l=w_div_l,
+                        neg_boost_l=neg_boost_l, valid_l=valid_l,
+                        stickiness_si=stick_si, prev_slot=prev_slot,
+                        prev_state=prev_state_ids,
+                        taken_ids=list(taken_ids), anchors=anchors_k,
+                        gids_l=gids_l, gid_valid=gid_valid, gids=gids,
+                        rules=rules[si])
+
+                    def min2_fn(price_vec):
+                        price_l = _node_slice(price_vec, node_axis, n_l)
+                        b, cl, s2, raw = fused_score_min2(
+                            price_l, si_pack, pbase, noff,
+                            nrules=len(rules[si]),
+                            jitter_scale=float(_JITTER),
+                            interpret=(fused_score == "interpret"))
+                        return _combine_min2(
+                            b, cl + noff, s2, raw, node_axis)
+
+                    base_full = (0.001 * total
+                                 / jnp.maximum(total_p, 1.0)) / w_div
+
+                    def score_at_fn(rows, cols_global):
+                        return score_at_columns(
+                            rows, cols_global, base_full=base_full,
+                            neg_boost_full=neg_boost, valid_full=valid,
+                            gids=gids, gid_valid=gid_valid,
+                            anchors=anchors_k, rules=rules[si],
+                            prev_slot=prev_slot,
+                            prev_state=prev_state_ids,
+                            taken_ids=taken_ids, stick=stick_si,
+                            jitter_scale=float(_JITTER), pbase=pbase)
+                else:
+                    balance = 0.001 * total_l[None, :] / \
+                        jnp.maximum(total_p, 1.0)
+                    score = balance / w_div_l[None, :]
+                    # Same-ordinal alignment: slot ri mildly prefers prev
+                    # slot ri's node (above jitter, below every real
+                    # term), so sticky bids don't scramble ordinals and
+                    # leftovers stay spread.
                     score = score - 0.01 * _member_ids(
-                        prev[:, si, ri:ri + 1], cols_l)
-                score = score + jnp.maximum(
-                    neg_boost_l[None, :],
-                    jnp.where(neg_boost_l[None, :] > 0,
-                              stickiness[:, si][:, None], 0.0))
-                score = score - stickiness[:, si][:, None] * _member_ids(
-                    prev_state_ids, cols_l)
-                # Per-slot rule penalty: anchored on the primary, every
-                # pinned ordinal, and every slot already assigned this
-                # state — so consecutive replicas spread across exclusion
-                # groups.
-                if rules[si]:
-                    score = score + _hier_penalty(
-                        anchors, gids, gid_valid, rules[si],
-                        gids_cand=gids_l)
-                taken = _member_ids(
-                    jnp.stack(taken_ids, axis=1), cols_l) if taken_ids \
-                    else jnp.zeros((p, n_l), jnp.bool_)
-                score = score + _INF * (taken | ~valid_l[None, :])
+                        prev_slot[:, None], cols_l)
+                    score = score + jnp.maximum(
+                        neg_boost_l[None, :],
+                        jnp.where(neg_boost_l[None, :] > 0,
+                                  stick_si[:, None], 0.0))
+                    score = score - stick_si[:, None] * _member_ids(
+                        prev_state_ids, cols_l)
+                    # Per-slot rule penalty: anchored on the primary,
+                    # every pinned ordinal, and every slot already
+                    # assigned this state — so consecutive replicas
+                    # spread across exclusion groups.
+                    if rules[si]:
+                        score = score + _hier_penalty(
+                            anchors, gids, gid_valid, rules[si],
+                            gids_cand=gids_l)
+                    taken = _member_ids(
+                        jnp.stack(taken_ids, axis=1), cols_l) if taken_ids \
+                        else jnp.zeros((p, n_l), jnp.bool_)
+                    score = score + _INF * (taken | ~valid_l[None, :])
+                    # Deterministic tie-break jitter (Weyl hash of GLOBAL
+                    # (partition, node) — shard-local indices would make
+                    # every shard bid on the same jitter-preferred
+                    # columns in lockstep, and break node-shard-count
+                    # invariance).
+                    pi = (pbase + jnp.arange(p))[:, None].astype(jnp.uint32)
+                    ni = cols_l[None, :].astype(jnp.uint32)
+                    score = score + jitter_scale * jitter_hash(pi, ni)
+
+                    def min2_fn(price_vec):
+                        price_l = _node_slice(price_vec, node_axis, n_l)
+                        if pallas_available():
+                            b_l, c_l, s_l = priced_min2_argmin(
+                                score, price_l)
+                        else:
+                            b_l, c_l, s_l = min2_argmin_reference(
+                                score + price_l[None, :])
+                        raw_l = jnp.take_along_axis(
+                            score, c_l[:, None], axis=1)[:, 0]
+                        return _combine_min2(
+                            b_l, c_l + noff, s_l, raw_l, node_axis)
+
+                    def score_at_fn(rows, cols_global):
+                        return _gather_cols(
+                            score, rows, cols_global, node_axis)
 
                 if rules[si]:
                     feasible_hint = None
@@ -1004,7 +1081,7 @@ def solve_dense(
                 cap = _shard_capacity(
                     jnp.ceil(total_w * cap_share), axis_name)
                 return _assign_slot(
-                    score, pweights, cap, 1.0 / w_div, jitter_scale,
+                    min2_fn, score_at_fn, p, pweights, cap, 1.0 / w_div,
                     axis_name, init_assign=init_assign, init_used=pin_used,
                     node_axis=node_axis, topup_share=cap_share,
                     has_rules=bool(rules[si]), feasible_hint=feasible_hint)
@@ -1033,7 +1110,7 @@ def solve_dense(
 
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
                                    "max_iterations", "node_axis",
-                                   "node_shards"))
+                                   "node_shards", "fused_score"))
 def solve_dense_converged(
     prev: jnp.ndarray,
     pweights: jnp.ndarray,
@@ -1048,6 +1125,7 @@ def solve_dense_converged(
     max_iterations: int = 10,
     node_axis: Optional[str] = None,
     node_shards: int = 1,
+    fused_score: str = "off",
 ) -> jnp.ndarray:
     """solve_dense iterated to a fixpoint (reference plan.go:23-58).
 
@@ -1063,7 +1141,7 @@ def solve_dense_converged(
     def solve(x):
         return solve_dense(x, pweights, nweights, valid, stickiness,
                            gids, gid_valid, constraints, rules, axis_name,
-                           node_axis, node_shards)
+                           node_axis, node_shards, fused_score)
 
     first = solve(prev)
 
@@ -1340,6 +1418,7 @@ def plan_next_map_tpu(
             constraints,
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
+            fused_score=_FUSED_SCORE_DEFAULT,
         ))
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")
