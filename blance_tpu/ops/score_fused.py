@@ -39,8 +39,8 @@ __all__ = ["fused_score_min2", "ScoreInputs", "pack_score_inputs",
 _INF = 1.0e9
 _RULE_MISS = 1.0e6
 _RULE_TIER = 1.0e4
-_J_MUL_P = 2654435761
-_J_MUL_N = 40503
+_J_MUL_P = 2654435761 - (1 << 32)  # int32 two's-complement bits of the
+_J_MUL_N = 40503                   # unsigned Weyl multiplier 2654435761
 
 
 def jitter_hash(pi: jnp.ndarray, ni: jnp.ndarray) -> jnp.ndarray:
@@ -48,9 +48,12 @@ def jitter_hash(pi: jnp.ndarray, ni: jnp.ndarray) -> jnp.ndarray:
     GLOBAL (partition, node) indices.  One spelling shared by the fused
     kernel, the point evaluator, the matrix engine in plan/tensor.py,
     and the test oracle — cross-engine decision equivalence depends on
-    these being identical.  Inputs must be uint32."""
-    return ((pi * jnp.uint32(_J_MUL_P) + ni * jnp.uint32(_J_MUL_N))
-            & jnp.uint32(0xFFFF)).astype(jnp.float32) / 65536.0
+    these being identical.  Inputs must be int32: XLA/Mosaic integer
+    ops wrap two's-complement, so the masked low 16 bits equal the
+    unsigned sequence bit-for-bit, and int32->float32 is a cast Mosaic
+    can lower in-kernel (uint32->float32 is not)."""
+    return ((pi * jnp.int32(_J_MUL_P) + ni * jnp.int32(_J_MUL_N))
+            & jnp.int32(0xFFFF)).astype(jnp.float32) / 65536.0
 
 
 class ScoreInputs(NamedTuple):
@@ -185,8 +188,11 @@ def _kernel(price_ref, base_ref, nb_ref, validf_ref, cand_ref, stick_ref,
                 inc_same = ainc[:, col:col + 1] == cand[idx:idx + 1, :]
                 exc_same = aexc[:, col:col + 1] == \
                     cand[nrules + idx:nrules + idx + 1, :]
-                sat = sat & jnp.where(present[:, ai:ai + 1] > 0,
-                                      inc_same & ~exc_same, True)
+                # (absent anchor passes) OR (rule gate) — spelled as
+                # boolean algebra, not jnp.where: a select over i1
+                # vectors lowers to an i8->i1 truncation Mosaic rejects.
+                sat = sat & ((present[:, ai:ai + 1] <= 0.0)
+                             | (inc_same & ~exc_same))
             pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
         score = score + jnp.where(anyr_ref[:] > 0, pen, 0.0)
     taken = taken_ref[:]
@@ -195,9 +201,9 @@ def _kernel(price_ref, base_ref, nb_ref, validf_ref, cand_ref, stick_ref,
         tk = tk | (taken[:, t:t + 1] == cols_g)
     score = score + _INF * (tk | (validf_ref[:] == 0.0)).astype(jnp.float32)
     # Deterministic tie-break jitter — identical hash to _assign_slot's.
-    pi = (pbase_ref[0, 0] + i * tile_p + jax.lax.broadcasted_iota(
-        jnp.int32, score.shape, 0)).astype(jnp.uint32)
-    score = score + jitter_scale * jitter_hash(pi, cols_g.astype(jnp.uint32))
+    pi = (pbase_ref[0, 0] + i * tile_p
+          + jax.lax.broadcasted_iota(jnp.int32, score.shape, 0))
+    score = score + jitter_scale * jitter_hash(pi, cols_g)
     # --- fused min2/argmin over score + price ---
     price = price_ref[:]
     x = score + price
@@ -355,5 +361,5 @@ def score_at_columns(
     for tid in taken_ids:
         tk = tk | (tid[rows] == c)
     s = s + _INF * (tk | ~valid_full[c]).astype(jnp.float32)
-    pi = (jnp.asarray(pbase).reshape(()) + rows).astype(jnp.uint32)
-    return s + jitter_scale * jitter_hash(pi, c.astype(jnp.uint32))
+    pi = (jnp.asarray(pbase).reshape(()) + rows).astype(jnp.int32)
+    return s + jitter_scale * jitter_hash(pi, c.astype(jnp.int32))

@@ -547,8 +547,6 @@ def _assign_slot(
         raw_best_all = None
         hard_feasible = feasible_hint
 
-    _priced_min2 = min2_fn
-
     def round_body(carry):
         slot_assign, unassigned, rem_cap, used, _progress, it = carry
 
@@ -560,7 +558,7 @@ def _assign_slot(
         # kernel on TPU (blance_tpu/ops/reduce2.py); the XLA spelling
         # (priced [P, N] materialization + 3 reductions) elsewhere.
         price_vec = used * price_scale + jnp.where(rem_cap > 0, 0.0, _INF)
-        best, choice, second, raw_choice = _priced_min2(price_vec)
+        best, choice, second, raw_choice = min2_fn(price_vec)
         margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
 
         # Rules-first gate (mirrors phase B's soft_ok): when every
@@ -707,7 +705,7 @@ def _assign_slot(
 
     def do_force(args):
         slot_assign, unassigned, used = args
-        best, choice, _second, _raw = _priced_min2(
+        best, choice, _second, _raw = min2_fn(
             used_global * price_scale)
         feasible = best < _INF / 2
         forced = unassigned & feasible
@@ -1039,8 +1037,8 @@ def solve_dense(
                     # every shard bid on the same jitter-preferred
                     # columns in lockstep, and break node-shard-count
                     # invariance).
-                    pi = (pbase + jnp.arange(p))[:, None].astype(jnp.uint32)
-                    ni = cols_l[None, :].astype(jnp.uint32)
+                    pi = (pbase + jnp.arange(p))[:, None].astype(jnp.int32)
+                    ni = cols_l[None, :].astype(jnp.int32)
                     score = score + jitter_scale * jitter_hash(pi, ni)
 
                     def min2_fn(price_vec):

@@ -119,8 +119,8 @@ def _reference_score(si: ScoreInputs, aux, pbase=0, noff=0):
                             ).astype(jnp.float32)
     from blance_tpu.ops.score_fused import jitter_hash
 
-    pi = (pbase + jnp.arange(P, dtype=jnp.int32))[:, None].astype(jnp.uint32)
-    jit = jitter_hash(pi, cols.astype(jnp.uint32))
+    pi = (pbase + jnp.arange(P, dtype=jnp.int32))[:, None]
+    jit = jitter_hash(pi, cols.astype(jnp.int32))
     return np.asarray(score + jnp.float32(1.0e-5) * jit)
 
 
@@ -245,7 +245,7 @@ def test_fused_solve_node_removal():
     assert check_assignment(p2, a2) == CLEAN
 
 
-def test_fused_default_plumbed_through_api(monkeypatch):
+def test_fused_default_plumbed_through_api():
     """set_fused_score_default routes plan_next_map_tpu through the
     fused engine; the public result honors the same contract."""
     import warnings as w
