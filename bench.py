@@ -333,24 +333,38 @@ def main():
     headline = None
     for P, N, is_headline in CONFIGS:
         entry = {"P": P, "N": N}
-        entry.update(bench_tpu(P, N))
-        entry["engine"] = "matrix"
+        try:
+            entry.update(bench_tpu(P, N))
+            entry["engine"] = "matrix"
+        except AssertionError:
+            # An audit failure is a correctness regression, not a
+            # capacity limit — the bench must fail loudly, not degrade.
+            raise
+        except Exception as e:
+            # Expected at the north-star shape: the matrix engine's
+            # [P, N] working set (~4 GB x several live copies at
+            # 100k x 10k) exceeds one chip's HBM.  The fused engine
+            # below, whose per-round traffic is O(P + N), is the
+            # production path at that scale.
+            log(f"[{P}x{N}] matrix engine failed ({type(e).__name__}: "
+                f"{str(e).splitlines()[0][:200]})")
+            entry["matrix_error"] = str(e).splitlines()[0][:200]
         if fused_ok:
             # The verify gate ran at 4096x512; this is a different static
             # shape — a lowering failure here must degrade to the matrix
-            # headline, not abort the bench.
+            # result, not abort the bench.
             try:
                 fused_res = bench_tpu(P, N, fused=True)
             except Exception as e:
                 log(f"[{P}x{N}] fused timed run failed "
-                    f"({type(e).__name__}: {str(e).splitlines()[0][:200]});"
-                    f" keeping matrix headline")
+                    f"({type(e).__name__}: {str(e).splitlines()[0][:200]})")
                 fused_res = None
             if fused_res is not None:
                 entry["fused"] = fused_res
             if fused_res is not None and \
-                    fused_res["solve_ms_min"] < entry["solve_ms_min"] and \
-                    not any(fused_res["violations"].values()):
+                    not any(fused_res["violations"].values()) and (
+                    "solve_ms_min" not in entry
+                    or fused_res["solve_ms_min"] < entry["solve_ms_min"]):
                 # Both engines are production-selectable
                 # (set_fused_score_default); report the better one as the
                 # headline and name it.
@@ -359,6 +373,11 @@ def main():
                                "solve_ms_median", "solve_ms_runs",
                                "violations")})
                 entry["engine"] = "fused"
+        if "solve_ms_min" not in entry:
+            log(f"[{P}x{N}] no engine produced a result; config recorded "
+                f"as failed")
+            detail["configs"].append(entry)
+            continue
         entry.update(bench_cpu(P, N))
         # End-to-end phases through the same engine as the headline solve.
         from blance_tpu.plan.tensor import set_fused_score_default
@@ -367,12 +386,23 @@ def main():
         try:
             entry["phases_ms"] = bench_phases(P, N)
         finally:
-            set_fused_score_default("off")
+            set_fused_score_default("auto")
         entry["vs_baseline"] = round(
             entry["cpu_s"] * 1000 / entry["solve_ms_min"], 1)
         detail["configs"].append(entry)
         if is_headline:
             headline = entry
+
+    if headline is None:
+        # The headline config failed outright on every engine; fall back
+        # to the largest config that did produce a number so the driver
+        # artifact still carries a measured result (plus the failure
+        # record above).
+        done = [e for e in detail["configs"] if "solve_ms_min" in e]
+        if not done:
+            log("FATAL: no config produced a result")
+            sys.exit(4)
+        headline = done[-1]
 
     def _k(n):
         return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)

@@ -186,7 +186,11 @@ class PlannerSession:
         the proposed assignment (does not adopt it — see apply())."""
         import jax.numpy as jnp
 
-        from .tensor import solve_dense_converged
+        from .tensor import (
+            _FUSED_SCORE_DEFAULT,
+            resolve_fused_score,
+            solve_dense_converged,
+        )
 
         prob = self._problem
         rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
@@ -213,7 +217,9 @@ class PlannerSession:
                 jnp.asarray(prob.stickiness),
                 jnp.asarray(prob.gids),
                 jnp.asarray(prob.gid_valid),
-                constraints, rules, max_iterations=iters))
+                constraints, rules, max_iterations=iters,
+                fused_score=resolve_fused_score(
+                    _FUSED_SCORE_DEFAULT, prob.P, prob.N)))
         from .tensor import maybe_validate
 
         maybe_validate(prob, assign, self.opts.validate_assignment,

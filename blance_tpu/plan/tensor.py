@@ -75,18 +75,62 @@ _JITTER = 1.0e-5
 # Pallas reduction kernel (ops/score_fused.py) so the matrix never
 # exists; "interpret" runs the fused kernel under the pallas interpreter
 # (CPU testing).  Passed into the jit as a static arg, so flipping the
-# default takes effect on the next call.  Conservative default: the
-# fused path is enabled where it has been verified on the device (see
-# bench.py's fused-vs-matrix check).
-_FUSED_SCORE_DEFAULT = "off"
+# default takes effect on the next call.  "auto" resolves per problem
+# size at the plan_next_map_tpu boundary (resolve_fused_score): the
+# matrix engine wins below the chip's memory ceiling (fewer kernel
+# launches), the fused engine is the only thing that fits above it.
+_FUSED_SCORE_DEFAULT = "auto"
+
+# Working-set model for the matrix engine: ~5 live [P, N] f32 copies
+# through an auction round (score build, priced copy, reduction temps).
+# Calibrated on v5e: 100k x 10k measured an 18.9 GB program requirement
+# = ~19 bytes/cell.
+_MATRIX_BYTES_PER_CELL = 20
+_HBM_BUDGET_FRACTION = 0.6
 
 
 def set_fused_score_default(mode: str) -> None:
     """Select the score engine for subsequent plan_next_map_tpu calls."""
     global _FUSED_SCORE_DEFAULT
-    if mode not in ("off", "on", "interpret"):
+    if mode not in ("off", "on", "interpret", "auto"):
         raise ValueError(f"unknown fused-score mode: {mode!r}")
     _FUSED_SCORE_DEFAULT = mode
+
+
+def _device_hbm_bytes() -> int:
+    """Accelerator memory per chip; 16 GiB (v5e) when the runtime does
+    not report a limit (e.g. CPU test meshes)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return 16 * 2 ** 30
+
+
+def resolve_fused_score(mode: str, p: int, n: int) -> str:
+    """Resolve "auto" to a concrete engine for a [P, N]-sized problem.
+
+    "auto" -> "on" (in-kernel score, O(P + N) traffic per round) when
+    the matrix engine's [P, N] working set would exceed the chip's
+    memory budget and the compiled Pallas path is available; "off"
+    (materialized score matrix) otherwise.  Explicit modes pass
+    through untouched.  Must run BEFORE jit: fused_score is a static
+    argument of solve_dense / solve_dense_converged, and "auto" there
+    is an error by design.
+    """
+    if mode != "auto":
+        return mode
+    from ..ops.reduce2 import pallas_available
+
+    if not pallas_available():
+        return "off"
+    if p * n * _MATRIX_BYTES_PER_CELL > \
+            _HBM_BUDGET_FRACTION * _device_hbm_bytes():
+        return "on"
+    return "off"
 
 
 def _drop_empty(ids: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -756,6 +800,11 @@ def solve_dense(
     Node ids in prev/assign are global throughout."""
     p, s, r_max = prev.shape
     n = nweights.shape[0]
+    if fused_score not in ("off", "on", "interpret"):
+        # "auto" must be resolved by resolve_fused_score BEFORE jit; a
+        # silent passthrough here would select the compiled kernel on
+        # hosts that can't run it.
+        raise ValueError(f"unresolved fused-score mode: {fused_score!r}")
     if constraints and max(constraints) > r_max:
         # JAX drops out-of-bounds scatter writes silently; without this the
         # slots beyond R would vanish while still consuming capacity.
@@ -1416,7 +1465,8 @@ def plan_next_map_tpu(
             constraints,
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
-            fused_score=_FUSED_SCORE_DEFAULT,
+            fused_score=resolve_fused_score(
+                _FUSED_SCORE_DEFAULT, problem.P, problem.N),
         ))
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")

@@ -274,4 +274,35 @@ def test_fused_default_plumbed_through_api():
             rs = [rackof[x] for x in p.nodes_by_state["replica"]]
             assert pr not in rs and len(set(rs)) == 2
     finally:
-        T.set_fused_score_default("off")
+        T.set_fused_score_default("auto")
+
+
+def test_resolve_fused_score_passthrough_and_auto(monkeypatch):
+    """"auto" picks the engine from the matrix working-set estimate;
+    explicit modes pass through; "auto" never reaches the jitted solver
+    (solve_dense rejects it)."""
+    from blance_tpu.plan import tensor as T
+
+    for mode in ("off", "on", "interpret"):
+        assert T.resolve_fused_score(mode, 100_000, 10_000) == mode
+
+    # Auto without the compiled Pallas path (this CPU host): matrix
+    # engine regardless of size.
+    monkeypatch.setattr("blance_tpu.ops.reduce2.pallas_available",
+                        lambda: False)
+    assert T.resolve_fused_score("auto", 100_000, 10_000) == "off"
+
+    # Auto with Pallas and a 16 GiB chip: small problems stay on the
+    # matrix engine, the north-star shape must switch to fused.
+    monkeypatch.setattr("blance_tpu.ops.reduce2.pallas_available",
+                        lambda: True)
+    monkeypatch.setattr(T, "_device_hbm_bytes", lambda: 16 * 2 ** 30)
+    assert T.resolve_fused_score("auto", 100_000, 1_000) == "off"
+    assert T.resolve_fused_score("auto", 100_000, 10_000) == "on"
+
+    with pytest.raises(ValueError, match="unresolved fused-score"):
+        T.solve_dense(
+            jnp.full((4, 1, 1), -1, jnp.int32), jnp.ones(4), jnp.ones(3),
+            jnp.ones(3, bool), jnp.full((4, 1), 1.5),
+            jnp.zeros((1, 3), jnp.int32), jnp.ones((1, 3), bool),
+            (1,), ((),), fused_score="auto")
