@@ -45,6 +45,12 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def first_line(e):
+    """First line of an exception message, '' when the message is empty
+    (a bare RuntimeError() must not crash the degradation path)."""
+    return (str(e).splitlines() or [""])[0][:200]
+
+
 def build_dense(P, N, seed=0):
     """Dense arrays for the rack-rule delta-rebalance shape."""
     rng = np.random.default_rng(seed)
@@ -179,7 +185,7 @@ def verify_fused_engine():
         except Exception as e:  # a kernel that won't lower must not
             log(f"fused-engine verify: mode={mode} failed to "  # kill the
                 f"compile/run: {type(e).__name__}: "            # bench
-                f"{str(e).splitlines()[0][:200]}")
+                f"{first_line(e)}")
             return False
         counts = audit(a, valid, gids)
         if any(counts.values()):
@@ -347,8 +353,8 @@ def main():
             # below, whose per-round traffic is O(P + N), is the
             # production path at that scale.
             log(f"[{P}x{N}] matrix engine failed ({type(e).__name__}: "
-                f"{str(e).splitlines()[0][:200]})")
-            entry["matrix_error"] = str(e).splitlines()[0][:200]
+                f"{first_line(e)})")
+            entry["matrix_error"] = first_line(e)
         if fused_ok:
             # The verify gate ran at 4096x512; this is a different static
             # shape — a lowering failure here must degrade to the matrix
@@ -357,7 +363,7 @@ def main():
                 fused_res = bench_tpu(P, N, fused=True)
             except Exception as e:
                 log(f"[{P}x{N}] fused timed run failed "
-                    f"({type(e).__name__}: {str(e).splitlines()[0][:200]})")
+                    f"({type(e).__name__}: {first_line(e)})")
                 fused_res = None
             if fused_res is not None:
                 entry["fused"] = fused_res

@@ -186,11 +186,7 @@ class PlannerSession:
         the proposed assignment (does not adopt it — see apply())."""
         import jax.numpy as jnp
 
-        from .tensor import (
-            _FUSED_SCORE_DEFAULT,
-            resolve_fused_score,
-            solve_dense_converged,
-        )
+        from .tensor import resolve_default_fused_score, solve_dense_converged
 
         prob = self._problem
         rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
@@ -218,8 +214,7 @@ class PlannerSession:
                 jnp.asarray(prob.gids),
                 jnp.asarray(prob.gid_valid),
                 constraints, rules, max_iterations=iters,
-                fused_score=resolve_fused_score(
-                    _FUSED_SCORE_DEFAULT, prob.P, prob.N)))
+                fused_score=resolve_default_fused_score(prob.P, prob.N)))
         from .tensor import maybe_validate
 
         maybe_validate(prob, assign, self.opts.validate_assignment,

@@ -133,6 +133,14 @@ def resolve_fused_score(mode: str, p: int, n: int) -> str:
     return "off"
 
 
+def resolve_default_fused_score(p: int, n: int) -> str:
+    """The session-default engine mode, resolved for a [P, N] problem.
+    The one spelling every entry point (plan_next_map_tpu,
+    PlannerSession.replan, future callers) uses to turn the module
+    default into a concrete jit-safe mode."""
+    return resolve_fused_score(_FUSED_SCORE_DEFAULT, p, n)
+
+
 def _drop_empty(ids: jnp.ndarray, n: int) -> jnp.ndarray:
     """Map empty (-1) ids to n so scatters with mode='drop' discard them.
 
@@ -1465,8 +1473,7 @@ def plan_next_map_tpu(
             constraints,
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
-            fused_score=resolve_fused_score(
-                _FUSED_SCORE_DEFAULT, problem.P, problem.N),
+            fused_score=resolve_default_fused_score(problem.P, problem.N),
         ))
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")

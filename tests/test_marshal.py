@@ -121,3 +121,43 @@ def test_none_in_prev_map_falls_back():
     problem = enc.encode_problem(prev, parts, ["n0", "n1"], None, model,
                                  PlanOptions())
     assert problem.prev[0, 0, 0] == -1 and problem.prev[1, 0, 0] == 0
+
+
+def test_fast_ctor_parity_and_post_init_fallback():
+    """build_map's __init__-bypassing constructor produces objects
+    indistinguishable from normal construction, and a Partition subclass
+    with __post_init__ (whose hook skipping __init__ would silence) takes
+    the ordinary-call path so the hook still runs."""
+    _with_native(True)
+    native = marshal.get()
+    assert native is not None
+
+    parts = ["a", "b"]
+    rows = [[["n0"], ["n1"]]]
+    pta = {"a": Partition("a", {}), "b": Partition("b", {})}
+    out = native.build_map(Partition, parts, ["primary"], rows, pta,
+                           {"primary"}, set())
+    normal = Partition("a", {"primary": ["n0"]})
+    got = out["a"]
+    assert type(got) is Partition
+    assert got == normal  # dataclass __eq__ over all fields
+    assert got.copy().nodes_by_state == {"primary": ["n0"]}
+
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Hooked(Partition):
+        def __post_init__(self):
+            self.hooked = True
+
+    out = native.build_map(Hooked, parts, ["primary"], rows, pta,
+                           {"primary"}, set())
+    assert out["b"].hooked  # hook ran => the bypass was NOT taken
+
+    @dataclasses.dataclass
+    class Tagged(Partition):
+        tags: list = dataclasses.field(default_factory=list)
+
+    out = native.build_map(Tagged, parts, ["primary"], rows, pta,
+                           {"primary"}, set())
+    assert out["a"].tags == []  # extra field initialized => normal __init__
