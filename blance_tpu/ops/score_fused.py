@@ -235,7 +235,7 @@ def _kernel(price_ref, base_ref, nb_ref, validf_ref, cand_ref, stick_ref,
 
 @functools.partial(
     jax.jit, static_argnames=("nrules", "jitter_scale", "tile_p", "tile_n",
-                              "interpret"))
+                              "interpret", "vma"))
 def fused_score_min2(
     price: jnp.ndarray,  # [N_l] f32, +INF where closed
     si: ScoreInputs,
@@ -247,10 +247,15 @@ def fused_score_min2(
     tile_p: int = 256,
     tile_n: int = 2048,
     interpret: bool = False,
+    vma: tuple = (),
 ):
     """(best, choice_LOCAL, second, raw) per row; score built in-VMEM.
 
-    The caller adds ``noff`` to the returned choice for global ids."""
+    The caller adds ``noff`` to the returned choice for global ids.
+    ``vma`` names the mesh axes the outputs vary over when called under
+    shard_map (the partition axis always; the node axis too on a 2-D
+    mesh) — shard_map's varying-axes checker requires the annotation on
+    pallas_call outputs."""
     p = si.stick.shape[0]
     n = price.shape[0]
     if n == 0:
@@ -263,11 +268,12 @@ def fused_score_min2(
     t_width = si.taken.shape[1]
     a_width = si.present.shape[1]
 
+    sds_kw = {"vma": frozenset(vma)} if vma else {}
     out_shape = [
-        jax.ShapeDtypeStruct((p, 1), jnp.float32),  # best
-        jax.ShapeDtypeStruct((p, 1), jnp.int32),    # idx (local)
-        jax.ShapeDtypeStruct((p, 1), jnp.float32),  # second
-        jax.ShapeDtypeStruct((p, 1), jnp.float32),  # raw at idx
+        jax.ShapeDtypeStruct((p, 1), jnp.float32, **sds_kw),  # best
+        jax.ShapeDtypeStruct((p, 1), jnp.int32, **sds_kw),    # idx (local)
+        jax.ShapeDtypeStruct((p, 1), jnp.float32, **sds_kw),  # second
+        jax.ShapeDtypeStruct((p, 1), jnp.float32, **sds_kw),  # raw at idx
     ]
     out_spec = pl.BlockSpec((tp, 1), lambda i, j: (i, 0))
     row1 = pl.BlockSpec((1, tn), lambda i, j: (0, j))

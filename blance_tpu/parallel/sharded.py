@@ -147,6 +147,7 @@ def solve_dense_sharded(
     constraints: tuple,
     rules: tuple,
     max_iterations: int = 10,
+    fused_score: Optional[str] = None,
 ) -> np.ndarray:
     """Run the converged solve under shard_map, partition axis sharded.
 
@@ -162,6 +163,22 @@ def solve_dense_sharded(
     node_shards = axes.get(NODE_AXIS, 1)
     node_axis = NODE_AXIS if node_shards > 1 else None
     p_orig = prev.shape[0]
+    if fused_score is None:
+        # None = follow the module default, same as the single-chip entry
+        # points (plan_next_map_tpu, PlannerSession.replan) — a caller
+        # who never touches knobs gets "auto" on every path.
+        from ..plan import tensor as _tensor
+
+        fused_score = _tensor._FUSED_SCORE_DEFAULT
+    if fused_score == "auto":
+        # Resolve against the PER-SHARD slice: each device holds
+        # P/n_shards rows (x N/node_shards columns) of every [P, N]
+        # intermediate, so that is the working set the chip must fit.
+        from ..plan.tensor import resolve_fused_score
+
+        fused_score = resolve_fused_score(
+            "auto", -(-prev.shape[0] // n_shards),
+            -(-np.asarray(nweights).shape[-1] // node_shards))
 
     prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
     pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
@@ -187,11 +204,12 @@ def solve_dense_sharded(
         max_iterations=max_iterations,
         node_axis=node_axis,
         node_shards=node_shards,
+        fused_score=fused_score,
     )
     sm = partial(jax.shard_map, body, mesh=mesh,
                  in_specs=(shard, shard, rep, rep, shard, rep, rep),
                  out_specs=shard)
-    if not node_axis:
+    if not node_axis and fused_score == "off":
         fn = sm()
     else:
         # The output is node-replicated by construction — every node shard
@@ -199,7 +217,12 @@ def solve_dense_sharded(
         # property tests/test_sharded_2d.py proves empirically (solves are
         # bit-identical across node-shard counts) — but the varying-axes
         # checker can't see through the all_gather/psum combine, so disable
-        # it on 2-D meshes.  The disable kwarg has been renamed across JAX
+        # it on 2-D meshes.  The fused engine needs the same disable on
+        # ANY mesh: the checker's per-op vma propagation inside
+        # pallas_call rejects the kernel's mix of node-replicated [N]
+        # tables and partition-varying columns (its outputs carry correct
+        # vma annotations; the per-op walk is what can't see through).
+        # The disable kwarg has been renamed across JAX
         # versions (check_vma today, check_rep before); probe by retrying
         # rather than inspecting, so a version exposing neither still
         # builds (and then simply runs with the checker on).
@@ -228,7 +251,7 @@ def solve_dense_sharded(
 
 
 def solve_problem_sharded(
-    mesh: Mesh, problem: DenseProblem
+    mesh: Mesh, problem: DenseProblem, fused_score: Optional[str] = None
 ) -> np.ndarray:
     """Convenience: solve an encoded DenseProblem on a mesh."""
     rules = tuple(tuple(problem.rules.get(si, ())) for si in range(problem.S))
@@ -244,4 +267,5 @@ def solve_problem_sharded(
         problem.gid_valid,
         constraints,
         rules,
+        fused_score=fused_score,
     )

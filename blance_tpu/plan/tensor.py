@@ -1038,13 +1038,16 @@ def solve_dense(
                         gids_l=gids_l, gid_valid=gid_valid, gids=gids,
                         rules=rules[si])
 
+                    vma = tuple(a for a in (axis_name, node_axis) if a)
+
                     def min2_fn(price_vec):
                         price_l = _node_slice(price_vec, node_axis, n_l)
                         b, cl, s2, raw = fused_score_min2(
                             price_l, si_pack, pbase, noff,
                             nrules=len(rules[si]),
                             jitter_scale=float(_JITTER),
-                            interpret=(fused_score == "interpret"))
+                            interpret=(fused_score == "interpret"),
+                            vma=vma)
                         return _combine_min2(
                             b, cl + noff, s2, raw, node_axis)
 

@@ -40,6 +40,31 @@ fast_ctor_ok(PyTypeObject *tp)
         return 0;
     if (PyObject_HasAttrString((PyObject *)tp, "__post_init__"))
         return 0;
+    /* The __init__ the normal call would run must be dataclass-generated:
+     * the first class in the MRO providing __init__ must have gotten it
+     * from its own @dataclass decoration (i.e. that same class's __dict__
+     * also holds __dataclass_fields__).  A subclass overriding __init__
+     * by hand inherits __dataclass_fields__ but defines __init__ in its
+     * own __dict__ alone — skipping its validation would be silent. */
+    PyObject *mro = tp->tp_mro;
+    int generated = 0;
+    if (mro != NULL && PyTuple_Check(mro)) {
+        for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(mro); i++) {
+            PyObject *c = PyTuple_GET_ITEM(mro, i);
+            if (!PyType_Check(c))
+                break;
+            PyObject *d = ((PyTypeObject *)c)->tp_dict;
+            if (d == NULL || !PyDict_Check(d))
+                break;
+            if (PyDict_GetItemString(d, "__init__") != NULL) {
+                generated =
+                    PyDict_GetItemString(d, "__dataclass_fields__") != NULL;
+                break;
+            }
+        }
+    }
+    if (!generated)
+        return 0;
     /* The bypass writes exactly {name, nodes_by_state}; a subclass with
      * more dataclass fields (or none — a hand-rolled class) would come
      * out partially initialized, so require that exact field set. */

@@ -161,3 +161,14 @@ def test_fast_ctor_parity_and_post_init_fallback():
     out = native.build_map(Tagged, parts, ["primary"], rows, pta,
                            {"primary"}, set())
     assert out["a"].tags == []  # extra field initialized => normal __init__
+
+    class Custom(Partition):
+        # Hand-written __init__, NO @dataclass redecoration: inherits
+        # __dataclass_fields__ untouched — the gate must still take the
+        # ordinary-call path so this normalization runs.
+        def __init__(self, name, nodes_by_state):
+            super().__init__(name.upper(), nodes_by_state)
+
+    out = native.build_map(Custom, parts, ["primary"], rows, pta,
+                           {"primary"}, set())
+    assert out["a"].name == "A"  # custom __init__ ran

@@ -190,3 +190,28 @@ def test_sharded_rack_rules_zero_violations():
     problem, _, _, _ = _rack_problem()
     assign = solve_problem_sharded(make_mesh(8), problem)
     assert _rule_violations(problem, assign) == 0
+
+
+def test_sharded_fused_engine_contract():
+    """The fused in-kernel score engine under shard_map (interpret mode
+    on the virtual mesh): same contract as the matrix engine — zero
+    violations, rack-rule conformant, tight balance, deterministic —
+    so multi-chip deployments can use the engine that fits the
+    north-star shape on each shard."""
+    problem, parts, m, opts = _rack_problem(P=32, N=8)
+    af = solve_problem_sharded(make_mesh(4), problem,
+                               fused_score="interpret")
+    assert _rule_violations(problem, af) == 0
+    assert check_assignment(problem, af) == {
+        "duplicates": 0, "on_removed_nodes": 0,
+        "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
+    for si in range(2):
+        ids = af[:, si, :].ravel()
+        loads = np.bincount(ids[ids >= 0], minlength=8)
+        want = (si + 1) * 32 // 8
+        assert loads.max() - loads.min() <= 3, (si, loads)
+        assert loads.sum() == want * 8
+    # Deterministic re-solve.
+    assert np.array_equal(
+        af, solve_problem_sharded(make_mesh(4), problem,
+                                  fused_score="interpret"))
