@@ -27,11 +27,33 @@ device batch before enabling the fused path for timed runs.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+# Tile shape for the score kernel, overridable for tuning sweeps.  Read
+# once at import: the values are jit-static, so changing them mid-process
+# would silently recompile rather than retune.
+
+
+def _tile_env(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if v < 1:
+        raise ValueError(f"{name}={v} must be >= 1")
+    return v
+
+
+_TILE_P = _tile_env("BLANCE_FUSED_TILE_P", 256)
+_TILE_N = _tile_env("BLANCE_FUSED_TILE_N", 2048)
 
 __all__ = ["fused_score_min2", "ScoreInputs", "pack_score_inputs",
            "score_at_columns", "jitter_hash"]
@@ -244,8 +266,8 @@ def fused_score_min2(
     *,
     nrules: int,
     jitter_scale: float,
-    tile_p: int = 256,
-    tile_n: int = 2048,
+    tile_p: int = _TILE_P,
+    tile_n: int = _TILE_N,
     interpret: bool = False,
     vma: tuple = (),
 ):
