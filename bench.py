@@ -315,11 +315,16 @@ def main():
 
         try:
             subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c",
+                 # Enumerate AND compute: a wedged runtime can pass
+                 # device listing yet hang at the first dispatch.
+                 "import jax, numpy; numpy.asarray("
+                 "jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))"],
                 timeout=240, check=True, capture_output=True)
         except subprocess.TimeoutExpired:
-            log("FATAL: jax.devices() did not return within 240s — device "
-                "runtime unreachable; aborting instead of hanging the driver")
+            log("FATAL: device probe (enumerate + tiny matmul) did not "
+                "return within 240s — device runtime unreachable; aborting "
+                "instead of hanging the driver")
             sys.exit(3)
         except subprocess.CalledProcessError as e:
             log(f"FATAL: device probe failed: {e.stderr.decode()[-500:]}")
