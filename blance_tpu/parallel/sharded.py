@@ -163,20 +163,20 @@ def solve_dense_sharded(
     node_shards = axes.get(NODE_AXIS, 1)
     node_axis = NODE_AXIS if node_shards > 1 else None
     p_orig = prev.shape[0]
+    # Module-attribute access keeps the default and resolver
+    # monkeypatch-visible (tests patch tensor-module attributes).
+    from ..plan import tensor as _tensor
+
     if fused_score is None:
         # None = follow the module default, same as the single-chip entry
         # points (plan_next_map_tpu, PlannerSession.replan) — a caller
         # who never touches knobs gets "auto" on every path.
-        from ..plan import tensor as _tensor
-
         fused_score = _tensor._FUSED_SCORE_DEFAULT
     if fused_score == "auto":
         # Resolve against the PER-SHARD slice: each device holds
         # P/n_shards rows (x N/node_shards columns) of every [P, N]
         # intermediate, so that is the working set the chip must fit.
-        from ..plan.tensor import resolve_fused_score
-
-        fused_score = resolve_fused_score(
+        fused_score = _tensor.resolve_fused_score(
             "auto", -(-prev.shape[0] // n_shards),
             -(-np.asarray(nweights).shape[-1] // node_shards))
 
