@@ -1,0 +1,37 @@
+"""Build-regression guard for the native layers.
+
+The marshal and planner test files skip wholesale when their native
+module is unavailable — correct on machines with no toolchain, but a
+silent hole when a compiler exists and the build itself regressed (a
+syntax error in marshal.c would otherwise just skip 10 parity tests).
+These tests FAIL, not skip, whenever a C/C++ toolchain is present but
+the native layer won't load.
+"""
+
+import shutil
+
+import pytest
+
+
+def _has(*names):
+    return any(shutil.which(n) for n in names)
+
+
+@pytest.mark.skipif(not _has("cc", "gcc", "clang"),
+                    reason="no C compiler on this machine")
+def test_marshal_extension_builds():
+    from blance_tpu.core import marshal
+
+    assert marshal.available(), (
+        "C toolchain present but the marshal extension failed to "
+        "build/load — check the compile log under core/_native_build")
+
+
+@pytest.mark.skipif(not _has("c++", "g++", "clang++"),
+                    reason="no C++ compiler on this machine")
+def test_native_planner_builds():
+    from blance_tpu.plan.native import native_available
+
+    assert native_available(), (
+        "C++ toolchain present but the native planner failed to "
+        "build/load")
