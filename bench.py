@@ -313,21 +313,36 @@ def main():
     if not args.smoke:
         import subprocess
 
-        try:
-            subprocess.run(
-                [sys.executable, "-c",
-                 # Enumerate AND compute: a wedged runtime can pass
-                 # device listing yet hang at the first dispatch.
-                 "import jax, numpy; numpy.asarray("
-                 "jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))"],
-                timeout=240, check=True, capture_output=True)
-        except subprocess.TimeoutExpired:
-            log("FATAL: device probe (enumerate + tiny matmul) did not "
-                "return within 240s — device runtime unreachable; aborting "
-                "instead of hanging the driver")
-            sys.exit(3)
-        except subprocess.CalledProcessError as e:
-            log(f"FATAL: device probe failed: {e.stderr.decode()[-500:]}")
+        # Device wedges can be transient (a killed mid-compile client can
+        # stall the runtime for a while): retry the probe a few times with
+        # pauses before giving up, so a recovery inside the window still
+        # yields a measured artifact.  Worst case stays bounded (~14 min).
+        attempts, last = 3, None
+        for attempt in range(1, attempts + 1):
+            try:
+                subprocess.run(
+                    [sys.executable, "-c",
+                     # Enumerate AND compute: a wedged runtime can pass
+                     # device listing yet hang at the first dispatch.
+                     "import jax, numpy; numpy.asarray("
+                     "jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))"],
+                    timeout=240, check=True, capture_output=True)
+                break
+            except subprocess.TimeoutExpired:
+                last = "device probe (enumerate + tiny matmul) did not " \
+                    "return within 240s — device runtime unreachable"
+            except subprocess.CalledProcessError as e:
+                # Non-zero exit is deterministic (broken install/config),
+                # not a transient wedge — fail fast, no retries.
+                log(f"FATAL: device probe failed: "
+                    f"{e.stderr.decode()[-500:]}")
+                sys.exit(3)
+            if attempt < attempts:
+                log(f"probe attempt {attempt}/{attempts} failed ({last}); "
+                    f"retrying in 60s")
+                time.sleep(60)
+        else:
+            log(f"FATAL: {last}; aborting instead of hanging the driver")
             sys.exit(3)
 
     import jax
