@@ -334,6 +334,14 @@ class Orchestrator:
 
     def _find_next_moves(self, node: str, next_moves_arr: list[NextMoves]) -> int:
         """Ask the app which available move to do next (orchestrate.go:699-714)."""
+        if self._find_move is lowest_weight_partition_move_for_node:
+            # Fast path for the default policy: it reads only each
+            # candidate's .op, which the cursor's NodeStateOp already
+            # carries — hand it those directly instead of materializing
+            # PartitionMove views (measured ~50% of scheduler time at 8k
+            # partitions).  One copy of the policy semantics either way.
+            return lowest_weight_partition_move_for_node(
+                node, [nm.moves[nm.next] for nm in next_moves_arr])
         moves = [
             PartitionMove(
                 partition=nm.partition,

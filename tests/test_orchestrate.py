@@ -513,3 +513,50 @@ def test_orchestrate_moves(case):
     for partition, exp_seq in case["expect"].items():
         got = [(p, n, s) for (p, n, s, _op) in recs[partition]]
         assert got == exp_seq, f"{case['label']}: {partition}: {got} != {exp_seq}"
+
+
+def test_orchestrate_custom_find_move_views():
+    """A NON-default FindMoveFunc takes the PartitionMove-materializing
+    path (the default policy short-circuits past it): the views handed to
+    the callback must carry the cursor's exact (partition, node, state,
+    op), and the returned index must be honored — exercised with a
+    highest-weight-first policy, the reverse of the default."""
+    from blance_tpu.orchestrate import MOVE_OP_WEIGHT, PartitionMove
+
+    seen = []
+
+    def heaviest_first(node, moves):
+        for m in moves:
+            assert isinstance(m, PartitionMove)
+            seen.append((m.partition, m.node, m.state, m.op))
+        r = 0
+        for i, m in enumerate(moves):
+            if MOVE_OP_WEIGHT.get(m.op, 0) > MOVE_OP_WEIGHT.get(moves[r].op, 0):
+                r = i
+        return r
+
+    _, recs, assign = mk_funcs()
+
+    async def go():
+        o = orchestrate_moves(
+            MR_MODEL, OPTIONS1, ["a", "b", "c"],
+            pm({"00": {"replica": ["a"]}, "01": {"replica": ["a"]}}),
+            pm({"00": {"replica": ["b"]}, "01": {"replica": ["c"]}}),
+            assign,
+            heaviest_first,
+        )
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+
+    run(go())
+
+    # Every move executed exactly once, adds before dels per partition.
+    for p, dst in (("00", "b"), ("01", "c")):
+        ops = [(n, s, op) for (_p, n, s, op) in recs[p]]
+        assert (dst, "replica", "add") in ops and ("a", "", "del") in ops
+        assert ops.index((dst, "replica", "add")) < ops.index(("a", "", "del"))
+    # The callback saw well-formed views for every candidate it was shown.
+    assert seen and all(
+        p in ("00", "01") and n in ("a", "b", "c") and op in ("add", "del")
+        for (p, n, _s, op) in seen)
