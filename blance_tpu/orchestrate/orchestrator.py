@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional, Union
 
 from ..core.types import PartitionMap, PartitionModel
@@ -108,7 +108,13 @@ class OrchestratorProgress:
     tot_progress_close: int = 0
 
     def snapshot(self) -> "OrchestratorProgress":
-        return replace(self, errors=list(self.errors))
+        # One snapshot per progress event: a shallow __dict__ copy is
+        # ~4x cheaper than dataclasses.replace (which re-runs __init__
+        # over all 20 fields); only `errors` needs its own list.
+        new = object.__new__(OrchestratorProgress)
+        new.__dict__.update(self.__dict__)
+        new.errors = list(self.errors)
+        return new
 
 
 @dataclass(frozen=True)
