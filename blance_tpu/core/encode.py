@@ -27,7 +27,6 @@ from . import marshal as _marshal
 from .hierarchy import find_ancestor, level_group_ids
 from .setops import strings_remove
 from .types import (
-    HierarchyRules,
     Partition,
     PartitionMap,
     PartitionModel,
