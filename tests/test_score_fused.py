@@ -365,3 +365,24 @@ def test_fused_matrix_equivalence_fuzz(seed):
         return int(loads.max() - loads.min())
 
     assert abs(spread(a_f) - spread(a_m)) <= 2, (spread(a_f), spread(a_m))
+
+
+def test_jitter_hash_matches_unsigned_weyl_oracle():
+    """The int32 spelling (required: Mosaic cannot lower uint32->f32)
+    must equal the mathematical unsigned Weyl sequence bit-for-bit —
+    two's-complement wraparound makes the masked low 16 bits identical.
+    Guards against 'simplifying' the negative multiplier back to its
+    unsigned form (which changes nothing numerically but regresses TPU
+    compilation) or touching the mask/divisor."""
+    from blance_tpu.ops.score_fused import jitter_hash
+
+    rng = np.random.default_rng(0)
+    pi = rng.integers(0, 2**31 - 1, 4096).astype(np.int32)
+    ni = rng.integers(0, 2**20, 4096).astype(np.int32)
+    got = np.asarray(jitter_hash(jnp.asarray(pi), jnp.asarray(ni)))
+    with np.errstate(over="ignore"):
+        want = ((pi.astype(np.uint32) * np.uint32(2654435761)
+                 + ni.astype(np.uint32) * np.uint32(40503))
+                & np.uint32(0xFFFF)).astype(np.float32) / 65536.0
+    assert np.array_equal(got, want)
+    assert got.min() >= 0.0 and got.max() < 1.0
