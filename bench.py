@@ -32,13 +32,27 @@ import time
 
 import numpy as np
 
-# (P, N, headline?) — both rack rules + 5% node removal.
+# (P, N, headline?) — both rack rules + 5% node removal.  The HEADLINE
+# config runs FIRST: the axon tunnel can wedge mid-session, and whatever
+# completed before the wedge must include the number the round is judged
+# on (every completed stage also persists to PROGRESS_PATH immediately).
 CONFIGS = [
-    (100_000, 1_000, False),
     (100_000, 10_000, True),  # north star (BASELINE.json)
+    (100_000, 1_000, False),
 ]
 RUNS = 4  # timed runs per config (min + median reported)
 PY_GREEDY_P = 4_000  # python-greedy fallback measured here, scaled in P
+CPU_TIMEOUT_S = 540  # budget for one full-size CPU baseline measurement
+
+
+def _progress_path():
+    """Anchored to this file, not the cwd — the driver may launch the
+    bench from anywhere, and persistence landing in a scratch dir would
+    defeat its purpose."""
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "BENCH_progress.json")
 
 
 def log(*args):
@@ -49,6 +63,23 @@ def first_line(e):
     """First line of an exception message, '' when the message is empty
     (a bare RuntimeError() must not crash the degradation path)."""
     return (str(e).splitlines() or [""])[0][:200]
+
+
+def save_progress(detail, stage):
+    """Persist everything measured so far.  The driver only captures the
+    final stdout JSON line; a tunnel wedge between stages would otherwise
+    eat every number already in hand, so each completed stage overwrites
+    this file with the full detail tree (stage-stamped)."""
+    import os
+
+    path = _progress_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"stage": stage, "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S"), "detail": detail}, f, indent=1)
+    except OSError as e:  # persistence is best-effort, never fatal
+        log(f"save_progress failed: {e}")
 
 
 def build_dense(P, N, seed=0):
@@ -256,39 +287,78 @@ def bench_phases(P, N):
     return phases
 
 
+# Child program for one CPU baseline measurement.  Runs in a subprocess so
+# the parent can enforce CPU_TIMEOUT_S (the native call is one C++ planner
+# invocation — uninterruptible in-process) and so the measurement can never
+# touch the device runtime (the child pins the cpu platform before any
+# blance_tpu import).
+_CPU_CHILD = r"""
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import bench
+P, N, backend = {P}, {N}, {backend!r}
+from blance_tpu import PlanOptions, model, plan_next_map
+prev, nodes, removed = bench._make_map(P, N)
+m = model(primary=(0, 1), replica=(1, 1))
+opts = bench._rack_opts(nodes)
+opts.max_iterations = 1  # single pass, same work as one solve
+t0 = time.perf_counter()
+plan_next_map(prev, prev, nodes, removed, [], m, opts, backend=backend)
+print(json.dumps({{"cpu_s": time.perf_counter() - t0}}))
+"""
+
+
 def bench_cpu(P, N):
-    """CPU baseline with explicit provenance: native C++ exact planner
-    when built (scaled linearly in P when the full size is impractical),
-    else the Python greedy scaled from PY_GREEDY_P."""
-    from blance_tpu import model, plan_next_map
+    """CPU baseline, MEASURED at the full problem size (no P-scaling): the
+    native C++ exact planner when built, else the Python greedy scaled
+    from PY_GREEDY_P (toolchain-less hosts only).  Runs under a hard
+    timeout; on expiry the elapsed budget is reported as an explicit
+    LOWER BOUND on the CPU time (so the derived speedup is a lower bound
+    too), never an extrapolation."""
+    import os
+    import subprocess
+
     from blance_tpu.plan.native import native_available
 
     use_native = native_available()
-    if use_native:
-        # Native at N=10k runs the full O(P*N) loop ~10x the 1k config;
-        # measure at P/10 and scale so the bench stays a few minutes.
-        cpu_p = P if N <= 1_000 else P // 10
-        backend = "native"
-    else:
-        cpu_p = PY_GREEDY_P
-        backend = "greedy"
-
-    from blance_tpu import PlanOptions
-
-    prev, nodes, removed = _make_map(cpu_p, N)
-    m = model(primary=(0, 1), replica=(1, 1))
-    opts = _rack_opts(nodes)
-    opts.max_iterations = 1  # single pass, same work as one solve
+    cpu_p = P if use_native else min(P, PY_GREEDY_P)
+    backend = "native" if use_native else "greedy"
+    child = _CPU_CHILD.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        P=cpu_p, N=N, backend=backend)
+    log(f"[{P}x{N}] cpu {backend} @ {cpu_p}x{N} (full-size measurement, "
+        f"timeout {CPU_TIMEOUT_S}s)...")
     t0 = time.perf_counter()
-    plan_next_map(prev, prev, nodes, removed, [], m, opts, backend=backend)
-    cpu_s = time.perf_counter() - t0
-    scale = P / cpu_p
+    try:
+        r = subprocess.run([sys.executable, "-c", child],
+                           timeout=CPU_TIMEOUT_S, capture_output=True,
+                           text=True, check=True)
+        cpu_s = json.loads(r.stdout.strip().splitlines()[-1])["cpu_s"]
+        bound = False
+    except subprocess.TimeoutExpired:
+        cpu_s = time.perf_counter() - t0  # elapsed budget = lower bound
+        bound = True
+    except (subprocess.CalledProcessError, ValueError, KeyError,
+            IndexError) as e:
+        err = getattr(e, "stderr", "") or str(e)
+        log(f"[{P}x{N}] cpu baseline child failed: {err[-400:]}")
+        return {"cpu_s": None, "baseline": f"{backend}-failed"}
+    # A timed-out partial run may only be reported UNSCALED: scaling a
+    # lower bound linearly in P would be exactly the extrapolation this
+    # function exists to avoid (it can only overstate the bound's claim).
+    scale = 1.0 if bound else P / cpu_p
     scaled = cpu_s * scale
     provenance = ("native-c++" if use_native else "python-greedy") + \
-        ("" if scale == 1 else f"-scaled-x{scale:g}-in-P")
-    log(f"[{P}x{N}] cpu {backend} @ {cpu_p}x{N}: {cpu_s:.2f}s"
+        ("" if scale == 1 else f"-scaled-x{scale:g}-in-P") + \
+        ("-timeout-lower-bound" if bound else "")
+    log(f"[{P}x{N}] cpu {backend}: "
+        + (f">= {cpu_s:.0f}s (timed out; lower bound)" if bound
+           else f"{cpu_s:.2f}s")
         + ("" if scale == 1 else f" -> scaled to P={P}: {scaled:.1f}s"))
-    return {"cpu_s": round(scaled, 2), "baseline": provenance}
+    return {"cpu_s": round(scaled, 2), "baseline": provenance,
+            "cpu_is_lower_bound": bound}
 
 
 def main():
@@ -299,8 +369,8 @@ def main():
 
     global CONFIGS, RUNS
     if args.smoke:
-        CONFIGS = [(512, 64, False), (512, 128, True)]
-        RUNS = 3
+        CONFIGS = [(512, 128, True), (512, 64, False)]  # headline first,
+        RUNS = 3                                        # like the real list
 
     # Fail fast if the device runtime is wedged: a hung tunnel makes
     # jax.devices() block forever inside native code (no Python timeout
@@ -348,7 +418,9 @@ def main():
     import jax
 
     log(f"devices: {jax.devices()}")
-    pallas, pallas_ok = verify_pallas(CONFIGS[-1][1])
+    # Verify at the LARGEST node count benched (the headline shape),
+    # regardless of config order.
+    pallas, pallas_ok = verify_pallas(max(c[1] for c in CONFIGS))
 
     fused_ok = not args.smoke and verify_fused_engine()
 
@@ -356,9 +428,15 @@ def main():
               "fused_engine_verified": fused_ok,
               "device": str(jax.devices()[0]), "jax": jax.__version__,
               "runs_per_config": RUNS}
+    save_progress(detail, "verified")
+
+    # Pass 1 — ALL device work, headline config first: if the tunnel
+    # wedges mid-session, the numbers already in hand (persisted after
+    # every config) include the one the round is judged on.
     headline = None
     for P, N, is_headline in CONFIGS:
         entry = {"P": P, "N": N}
+        detail["configs"].append(entry)
         try:
             entry.update(bench_tpu(P, N))
             entry["engine"] = "matrix"
@@ -381,6 +459,11 @@ def main():
             # result, not abort the bench.
             try:
                 fused_res = bench_tpu(P, N, fused=True)
+            except AssertionError:
+                # Same contract as the matrix path: a failed audit is a
+                # correctness regression and must abort loudly, not
+                # silently degrade to the matrix headline.
+                raise
             except Exception as e:
                 log(f"[{P}x{N}] fused timed run failed "
                     f"({type(e).__name__}: {first_line(e)})")
@@ -402,22 +485,36 @@ def main():
         if "solve_ms_min" not in entry:
             log(f"[{P}x{N}] no engine produced a result; config recorded "
                 f"as failed")
-            detail["configs"].append(entry)
+            save_progress(detail, f"solve {P}x{N} failed")
             continue
-        entry.update(bench_cpu(P, N))
         # End-to-end phases through the same engine as the headline solve.
         from blance_tpu.plan.tensor import set_fused_score_default
 
         set_fused_score_default("on" if entry["engine"] == "fused" else "off")
         try:
             entry["phases_ms"] = bench_phases(P, N)
+        except Exception as e:  # phases are attribution detail — a
+            log(f"[{P}x{N}] phase attribution failed "  # failure must not
+                f"({type(e).__name__}: {first_line(e)})")  # eat the solve
+            entry["phases_error"] = first_line(e)
         finally:
             set_fused_score_default("auto")
-        entry["vs_baseline"] = round(
-            entry["cpu_s"] * 1000 / entry["solve_ms_min"], 1)
-        detail["configs"].append(entry)
+        save_progress(detail, f"solve {P}x{N} done")
         if is_headline:
             headline = entry
+
+    # Pass 2 — CPU baselines (no device involvement: the measurement runs
+    # in a cpu-pinned subprocess, so a wedged tunnel can't block it).
+    for entry in detail["configs"]:
+        if "solve_ms_min" not in entry:
+            continue
+        entry.update(bench_cpu(entry["P"], entry["N"]))
+        if entry.get("cpu_s") is not None:
+            entry["vs_baseline"] = round(
+                entry["cpu_s"] * 1000 / entry["solve_ms_min"], 1)
+        else:
+            entry["vs_baseline"] = 0.0  # baseline failed; tagged above
+        save_progress(detail, f"cpu {entry['P']}x{entry['N']} done")
 
     if headline is None:
         # The headline config failed outright on every engine; fall back
@@ -428,7 +525,7 @@ def main():
         if not done:
             log("FATAL: no config produced a result")
             sys.exit(4)
-        headline = done[-1]
+        headline = max(done, key=lambda e: (e["P"], e["N"]))
 
     def _k(n):
         return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
