@@ -137,6 +137,13 @@ class PlanOptions:
     # Called as fn(ctx: NodeScoreContext, node: str) -> float; ties still break
     # by node position (reference plan.go:580 CustomNodeSorter).
     node_scorer: Optional[Callable] = None
+    # Custom node SORTER: replaces the whole candidate ordering — score
+    # AND tie-break policy — like assigning the reference's
+    # CustomNodeSorter package var a non-default sort.Interface factory
+    # (plan.go:566-580).  Called as fn(ctx: NodeScoreContext,
+    # nodes: list[str]) -> list[str]; must return a permutation of
+    # ``nodes``.  Takes precedence over node_scorer when both are set.
+    node_sorter: Optional[Callable] = None
 
     # --- compat switches ---
     # When True, state_stickiness applies even without partition_weights

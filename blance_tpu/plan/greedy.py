@@ -386,8 +386,17 @@ def _find_best_nodes(
         stickiness=stickiness,
         node_score_booster=opts.node_score_booster,
     )
-    scorer = opts.node_scorer or default_node_score
-    candidates = _sort_nodes(score_ctx, candidates, scorer)
+    if opts.node_sorter is not None:
+        # Full-sorter replacement (reference CustomNodeSorter,
+        # plan.go:566-580): the hook owns score AND tie-break policy.
+        def sort_candidates(nodes):
+            return list(opts.node_sorter(score_ctx, nodes))
+    else:
+        scorer = opts.node_scorer or default_node_score
+
+        def sort_candidates(nodes):
+            return _sort_nodes(score_ctx, nodes, scorer)
+    candidates = sort_candidates(candidates)
 
     if opts.hierarchy_rules is not None:
         # Hierarchy pass (plan.go:174-226): each rule contributes up to
@@ -407,7 +416,7 @@ def _find_best_nodes(
                 )
                 h_candidates = strings_intersect(h_candidates, ctx.nodes_next)
                 h_candidates = exclude_higher_priority(h_candidates)
-                h_candidates = _sort_nodes(score_ctx, h_candidates, scorer)
+                h_candidates = sort_candidates(h_candidates)
                 if h_candidates:
                     hierarchy_nodes.append(h_candidates[0])
                 elif candidates:

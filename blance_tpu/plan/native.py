@@ -106,7 +106,7 @@ def native_available() -> bool:
 def _native_supported(
     partitions_to_assign: PartitionMap, model: PartitionModel, opts: PlanOptions
 ) -> bool:
-    if opts.node_scorer is not None:
+    if opts.node_scorer is not None or opts.node_sorter is not None:
         return False
     booster = opts.node_score_booster
     if booster is not None and getattr(booster, "__blance_native__", None) != "cbgt":
