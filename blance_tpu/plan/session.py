@@ -186,7 +186,8 @@ class PlannerSession:
         the proposed assignment (does not adopt it — see apply())."""
         import jax.numpy as jnp
 
-        from .tensor import resolve_default_fused_score, solve_dense_converged
+        from . import tensor as _tensor
+        from .tensor import resolve_default_fused_score
 
         prob = self._problem
         rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
@@ -205,7 +206,7 @@ class PlannerSession:
                 prob.gids, prob.gid_valid, constraints, rules,
                 max_iterations=iters)
         else:
-            assign = np.asarray(solve_dense_converged(
+            assign, _engine = _tensor.solve_converged_resilient(
                 jnp.asarray(self.current),
                 jnp.asarray(prob.partition_weights),
                 jnp.asarray(prob.node_weights),
@@ -214,7 +215,9 @@ class PlannerSession:
                 jnp.asarray(prob.gids),
                 jnp.asarray(prob.gid_valid),
                 constraints, rules, max_iterations=iters,
-                fused_score=resolve_default_fused_score(prob.P, prob.N)))
+                mode=resolve_default_fused_score(prob.P, prob.N),
+                allow_fallback=_tensor._FUSED_SCORE_DEFAULT == "auto",
+                context="PlannerSession.replan")
         from .tensor import maybe_validate
 
         maybe_validate(prob, assign, self.opts.validate_assignment,

@@ -59,7 +59,7 @@ from ..ops.score_fused import (
 )
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
-           "check_assignment", "maybe_validate"]
+           "solve_converged_resilient", "check_assignment", "maybe_validate"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -1218,6 +1218,62 @@ def solve_dense_converged(
     return out
 
 
+def solve_converged_resilient(
+    prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+    constraints, rules, *, max_iterations: int, mode: str,
+    allow_fallback: bool, context: str, timer=None,
+) -> tuple[np.ndarray, str]:
+    """solve_dense_converged with engine-failure degradation.
+
+    The auto-selected engine is a prediction from a working-set model
+    (_MATRIX_BYTES_PER_CELL / _HBM_BUDGET_FRACTION, calibrated on one
+    chip generation); when the prediction is wrong the matrix engine can
+    die in compile (HBM over-subscription) — or, more rarely, a fused
+    kernel can hit a Mosaic lowering gap on a new toolchain.  With
+    ``allow_fallback`` (set iff the mode came from "auto", never for an
+    explicit user choice) a failed engine retries once on the opposite
+    one, surfacing the switch as a UserWarning and on the timer's
+    annotations — so production callers degrade exactly like bench.py
+    does, instead of erroring.  Returns (assignment, engine-that-ran).
+    """
+    import warnings as _warnings
+
+    def run(m: str) -> np.ndarray:
+        # np.asarray inside the guarded region: async dispatch can defer
+        # a runtime failure to the first host read.
+        return np.asarray(solve_dense_converged(
+            prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+            constraints, rules, max_iterations=max_iterations,
+            fused_score=m))
+
+    try:
+        out = run(mode)
+    except (ValueError, TypeError):
+        # Deterministic input/validation errors fail identically on every
+        # engine — retrying would double the failure and surface the
+        # wrong traceback.  The fallback is for the documented runtime
+        # cases only (HBM over-subscription, Mosaic lowering gaps).
+        raise
+    except Exception as e:
+        alt = {"off": "on", "on": "off"}.get(mode)
+        if not allow_fallback or alt is None or \
+                (alt == "on" and not pallas_available()):
+            raise
+        first = (str(e).splitlines() or [""])[0][:200]
+        _warnings.warn(
+            f"blance_tpu {context}: score engine {mode!r} failed to "
+            f"compile/run ({type(e).__name__}: {first}); retrying with "
+            f"{alt!r}", UserWarning, stacklevel=3)
+        out = run(alt)
+        mode = alt
+        if timer is not None:
+            timer.annotate("engine_fallback", f"-> {alt}")
+    if timer is not None:
+        timer.annotate("engine", {"off": "matrix", "on": "fused",
+                                  "interpret": "fused-interpret"}[mode])
+    return out, mode
+
+
 def _anchor_sat_np(
     anchor: np.ndarray,  # [P] node ids, -1 = absent
     gids: np.ndarray,  # [L, N]
@@ -1242,9 +1298,177 @@ def _anchor_sat_np(
     return out
 
 
-# Partition-block size for the hierarchy audit: bounds its peak numpy
-# temporaries to [n_rules, _HIER_CHUNK, N] regardless of P.
+# Partition-block size for the matrix-path hierarchy audit: bounds its
+# peak numpy temporaries to [n_rules, _HIER_CHUNK, N] regardless of P.
 _HIER_CHUNK = 4096
+
+
+def _audit_rules_nest(problem: DenseProblem) -> bool:
+    """True when every rule's exclude level is strictly finer than its
+    include level — the tree shape under which an exclude group lies
+    inside exactly one include group, so attainability reduces to group
+    counting (the same precondition _hier_floor_counts relies on in the
+    solver)."""
+    return all(exc < inc
+               for si in range(problem.S)
+               for (inc, exc) in (problem.rules.get(si) or []))
+
+
+def _count_hier_misses_fast(
+    problem: DenseProblem, assign: np.ndarray
+) -> int:
+    """Group-counting hierarchy audit: O(P·S·R·rules + N·L) host math.
+
+    Semantically identical to the matrix path (_count_hier_misses_block)
+    when every rule nests (_audit_rules_nest) — pinned by
+    tests/test_tensor.py's parity fuzz.  Instead of materializing
+    per-anchor satisfaction over all N candidates, the attainable tier
+    comes from counting: with the exclude level strictly finer than the
+    include level, the number of rule-satisfying open candidates is
+
+        count(valid nodes in the anchors' shared include group)
+        - sum over DISTINCT anchor exclude groups of count(valid in e)
+        - count(already-used nodes in the include group but in none of
+          those exclude groups)
+
+    — [N]-bincounts (one per hierarchy level, shared across rules) plus
+    [P] gathers.  The achieved tier is a point evaluation at the judged
+    node.  This is what makes the audit affordable at the north-star
+    scale, so validation defaults ON at every size (maybe_validate);
+    the reference's equivalent property surfaces as warnings
+    (plan.go:231-235).
+    """
+    P, S, R = assign.shape
+    N = problem.N
+    gids, gid_valid = problem.gids, problem.gid_valid
+    valid = problem.valid_node
+    if not any(problem.rules.get(si) for si in range(S)):
+        return 0
+
+    # Valid-node histogram per hierarchy level.  Ancestor PRESENCE is
+    # gid_valid, not the gid's sign: encode interns orphans into a shared
+    # ""-group with a real dense id and gid_valid=False (encode.py:
+    # level_group_ids + find_ancestor), while synthetic/test problems may
+    # spell absence as gid -1 — gate on gid_valid and drop negatives so
+    # both representations count identically.
+    cnt = np.zeros((gids.shape[0], N), np.int64)
+    for lv in range(gids.shape[0]):
+        g = gids[lv][valid & gid_valid[lv]]
+        g = g[g >= 0]
+        cnt[lv] = np.bincount(g, minlength=N)
+
+    # Joint histograms per rule: nodes of an exclude group that also hold
+    # a PRESENT include-level ancestor.  A node can sit in a real exclude
+    # group while its coarser ancestor is missing (e.g. a rack with no
+    # zone parent): such a node is never in the shared include group, so
+    # subtracting the full exclude-group count would over-subtract it.
+    # Present ancestors are tree-consistent (same exclude group + present
+    # include ancestor => same include group), so this joint count is
+    # exactly |e ∩ g| for every e counted under g.
+    cnt_pair: dict[tuple[int, int], np.ndarray] = {}
+    for si in range(S):
+        for (inc, exc) in (problem.rules.get(si) or []):
+            if (inc, exc) in cnt_pair:
+                continue
+            sel = valid & gid_valid[exc] & gid_valid[inc] & \
+                (gids[exc] >= 0) & (gids[inc] >= 0)
+            cnt_pair[(inc, exc)] = np.bincount(
+                gids[exc][sel], minlength=N)
+
+    top_anchor = problem.prev[:, 0, 0]
+    misses = 0
+    used_ids: list[np.ndarray] = []  # [P] global node ids, -1 = none
+
+    def point_sat(anchors, node, inc, exc):
+        """[P] bool: does ``node`` satisfy (inc, exc) for every present
+        anchor?  Validity gates on the anchor side only, exactly like
+        _anchor_sat_np / the device _anchor_rule_sat."""
+        nd = np.clip(node, 0, N - 1)
+        out = np.ones(P, bool)
+        for a in anchors:
+            aa = np.clip(a, 0, N - 1)
+            inc_same = (gids[inc][aa] == gids[inc][nd]) & gid_valid[inc][aa]
+            exc_same = (gids[exc][aa] == gids[exc][nd]) & gid_valid[exc][aa]
+            out &= np.where(a >= 0, inc_same & ~exc_same, True)
+        return out
+
+    def attainable_count(anchors, inc, exc):
+        """[P] count of rule-satisfying candidates among valid & unused
+        nodes, by group counting (see docstring)."""
+        # Shared include group across present anchors (else unsatisfiable).
+        g = np.full(P, -1, np.int64)
+        ok = np.ones(P, bool)
+        for a in anchors:
+            aa = np.clip(a, 0, N - 1)
+            a_g = np.where(gid_valid[inc][aa], gids[inc][aa], -2)
+            present = a >= 0
+            ok &= np.where(present & (g >= 0), a_g == g, True)
+            ok &= np.where(present & (g < 0), a_g >= 0, True)
+            g = np.where(present & (g < 0), a_g, g)
+        gc = np.clip(g, 0, N - 1)
+        count = cnt[inc][gc].astype(np.int64)
+
+        # Subtract distinct anchor exclude groups (each nested inside the
+        # shared include group, so each subtracts its full valid count).
+        e_seen: list[np.ndarray] = []
+        for a in anchors:
+            aa = np.clip(a, 0, N - 1)
+            e = np.where((a >= 0) & gid_valid[exc][aa], gids[exc][aa], -1)
+            dup = np.zeros(P, bool)
+            for prev_e in e_seen:
+                dup |= (e == prev_e) & (e >= 0)
+            count -= np.where((e >= 0) & ~dup,
+                              cnt_pair[(inc, exc)][np.clip(e, 0, N - 1)], 0)
+            e_seen.append(e)
+
+        # Subtract already-used nodes still standing in the include group:
+        # used nodes inside a counted exclude group are subtracted above
+        # already, so only those OUTSIDE every counted group go here.
+        for u in used_ids:
+            uu = np.clip(u, 0, N - 1)
+            in_g = (u >= 0) & valid[uu] & (gids[inc][uu] == g)
+            in_excl = np.zeros(P, bool)
+            for e in e_seen:
+                in_excl |= (e >= 0) & (gids[exc][uu] == e)
+            count -= (in_g & ~in_excl).astype(np.int64)
+        return np.where(ok & (g >= 0), count, 0)
+
+    for si in range(S):
+        rules_si = problem.rules.get(si) or []
+        big = len(rules_si)
+        if rules_si:
+            base = top_anchor if si == 0 else np.where(
+                assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor)
+            anchors: list[np.ndarray] = [base]
+            any_anchor = base >= 0
+        for j in range(R):
+            node_j = assign[:, si, j]
+            has = node_j >= 0
+            if rules_si and has.any():
+                achieved = np.full(P, big, np.int64)
+                attainable = np.full(P, big, np.int64)
+                for idx in reversed(range(big)):
+                    inc, exc = rules_si[idx]
+                    achieved = np.where(
+                        point_sat(anchors, node_j, inc, exc), idx, achieved)
+                    attainable = np.where(
+                        attainable_count(anchors, inc, exc) > 0,
+                        idx, attainable)
+                misses += int((has & any_anchor
+                               & (achieved > attainable)).sum())
+            if rules_si:
+                anchors.append(node_j)
+                any_anchor = any_anchor | has
+            # Cross-state exclusivity: every pick occupies its node for
+            # the whole partition.  Deduplicate (a malformed assignment
+            # can repeat a node; the matrix path's bool [P, N] ``used``
+            # dedups structurally, and duplicates are already counted by
+            # check_assignment separately).
+            dup = np.zeros(P, bool)
+            for u in used_ids:
+                dup |= (node_j == u) & has
+            used_ids.append(np.where(has & ~dup, node_j, -1))
+    return misses
 
 
 def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
@@ -1256,9 +1480,16 @@ def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
     on the assigned primary plus the state's earlier picks.
     Unsatisfiable rules never count: when no candidate reaches a better
     tier, the flat fallback is correct behavior (plan.go:214-220).
-    Partitions are audited independently, so the work runs in P-blocks of
-    _HIER_CHUNK to keep peak memory flat in P (at the north-star
-    100k x 10k that is ~40 MB of bool temporaries per rule, not ~1 GB)."""
+
+    Two implementations, same contract: the group-counting fast path
+    (O(P + N·L), _count_hier_misses_fast) whenever every rule's exclude
+    level is strictly finer than its include level — the common tree
+    shape — and the exhaustive [P, N] matrix path otherwise, run in
+    P-blocks of _HIER_CHUNK so peak memory stays flat in P (at the
+    north-star 100k x 10k that is ~40 MB of bool temporaries per rule,
+    not ~1 GB)."""
+    if _audit_rules_nest(problem):
+        return _count_hier_misses_fast(problem, assign)
     P = assign.shape[0]
     total = 0
     for lo in range(0, P, _HIER_CHUNK):
@@ -1324,11 +1555,14 @@ def check_assignment(
     (unmeetable rules degrade softly to the flat fallback and do NOT
     count, like the reference's warnings, plan.go:214-235).
 
-    Pure numpy.  Below the auto-validation ceiling (_VALIDATE_AUTO_CELLS)
-    it is noise next to the solve; with an explicit
-    ``validate_assignment=True`` at larger scales the hierarchy audit
-    streams in P-blocks (bounded memory, but O(P*N) time — tens of
-    seconds at 100k x 10k, so opt in deliberately).  See the
+    Pure numpy.  With nesting rules (every exclude level strictly finer
+    than its include level — the common tree shape) the hierarchy audit
+    runs by group counting in O(P + N·L), noise next to the solve at any
+    size, so maybe_validate defaults it ON at every scale.  Exotic
+    non-nesting rules fall back to the exhaustive [P, N] matrix audit
+    (streamed in P-blocks: bounded memory, but O(P*N) time — tens of
+    seconds at 100k x 10k), which stays behind the auto-validation
+    ceiling unless explicitly requested.  See the
     ``validate_assignment`` wiring in plan_next_map_tpu /
     PlannerSession.replan."""
     assign = np.asarray(assign)
@@ -1367,8 +1601,10 @@ def check_assignment(
             "hierarchy_misses": _count_hier_misses(problem, assign)}
 
 
-# Auto-validation ceiling: below this many [P, N] score cells the numpy
-# audit is noise next to the solve; above it, opt in explicitly.
+# Auto-validation ceiling for the EXOTIC-rules path only: the exhaustive
+# matrix audit is O(P*N) time, so above this many cells it needs an
+# explicit opt-in.  Nesting rules (the common case) audit in O(P + N·L)
+# and validate by default at every scale.
 _VALIDATE_AUTO_CELLS = 1 << 22
 
 
@@ -1383,7 +1619,8 @@ def maybe_validate(
     import warnings as _warnings
 
     if validate is None:
-        validate = problem.P * problem.N <= _VALIDATE_AUTO_CELLS
+        validate = _audit_rules_nest(problem) or \
+            problem.P * problem.N <= _VALIDATE_AUTO_CELLS
     if not validate:
         return None
     counts = check_assignment(problem, assign)
@@ -1399,12 +1636,13 @@ def _tpu_supported(opts: PlanOptions) -> bool:
 
     The device score bakes in the default scoring formula plus the cbgt
     booster shape max(-weight, stickiness); an arbitrary Python
-    ``node_scorer`` or a non-cbgt ``node_score_booster`` cannot run inside
-    the jitted computation (reference contract: plan.go:580,693-697).
+    ``node_scorer``/``node_sorter`` or a non-cbgt ``node_score_booster``
+    cannot run inside the jitted computation (reference contract:
+    plan.go:566-580,693-697).
     Negative node weights WITHOUT a booster are also unsupported: the
     reference ignores them entirely (plan.go:675-684 boosts only when the
     booster is set), while the device score would pin them."""
-    if opts.node_scorer is not None:
+    if opts.node_scorer is not None or opts.node_sorter is not None:
         return False
     booster = opts.node_score_booster
     if booster is not None and \
@@ -1465,7 +1703,7 @@ def plan_next_map_tpu(
     constraints = tuple(int(c) for c in problem.constraints)
 
     with timer.phase("solve"):
-        assign = np.asarray(solve_dense_converged(
+        assign, _engine = solve_converged_resilient(
             jnp.asarray(problem.prev),
             jnp.asarray(problem.partition_weights),
             jnp.asarray(problem.node_weights),
@@ -1476,8 +1714,11 @@ def plan_next_map_tpu(
             constraints,
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
-            fused_score=resolve_default_fused_score(problem.P, problem.N),
-        ))
+            mode=resolve_default_fused_score(problem.P, problem.N),
+            allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
+            context="plan_next_map_tpu",
+            timer=timer,
+        )
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")
     with timer.phase("decode"):

@@ -23,10 +23,15 @@ __all__ = ["PhaseTimer", "device_profile"]
 
 @dataclass
 class PhaseTimer:
-    """Accumulates wall-clock per named phase; phases may repeat."""
+    """Accumulates wall-clock per named phase; phases may repeat.
+
+    ``annotations`` carries non-timing facts a caller wants surfaced with
+    the timing report — e.g. which score engine the solve actually ran
+    after auto-selection/fallback (tensor.solve_converged_resilient)."""
 
     totals: dict[str, float] = field(default_factory=dict)
     counts: dict[str, int] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -38,17 +43,24 @@ class PhaseTimer:
             self.totals[name] = self.totals.get(name, 0.0) + elapsed
             self.counts[name] = self.counts.get(name, 0) + 1
 
-    def report(self) -> dict[str, dict[str, float]]:
-        return {
+    def annotate(self, key: str, value: str) -> None:
+        self.annotations[key] = value
+
+    def report(self) -> dict[str, dict]:
+        out: dict = {
             name: {"total_s": self.totals[name], "count": self.counts[name]}
             for name in self.totals
         }
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        return out
 
     def __str__(self) -> str:
         parts = [
             f"{name}: {self.totals[name]*1000:.1f}ms x{self.counts[name]}"
             for name in sorted(self.totals, key=self.totals.get, reverse=True)
         ]
+        parts += [f"{k}={v}" for k, v in sorted(self.annotations.items())]
         return "; ".join(parts)
 
 
