@@ -58,7 +58,10 @@ def slice_major_order(slice_ids: list) -> list:
     return sorted(range(len(slice_ids)), key=lambda i: (slice_ids[i], i))
 
 
-def make_hybrid_mesh() -> Mesh:
+def make_hybrid_mesh(
+    devices: Optional[list] = None,
+    slice_ids: Optional[list] = None,
+) -> Mesh:
     """Multi-slice (multi-host) 1-D mesh, DCN-aware.
 
     The solver's only cross-shard traffic is per-node [N] psums, so a 1-D
@@ -70,6 +73,12 @@ def make_hybrid_mesh() -> Mesh:
     runtime's topology order) to guarantee that contiguity; on a single
     slice it is equivalent to :func:`make_mesh`.
 
+    ``devices`` / ``slice_ids`` default to the runtime's enumeration and
+    each device's ``slice_index``; passing them explicitly lets tests
+    (and exotic topologies) drive the multi-slice ordering with synthetic
+    slice assignments — tests/test_sharded.py solves end-to-end on a
+    2-slice hybrid mesh built from the 8 virtual CPU devices this way.
+
     Caveat: within a slice the runtime's enumeration order is trusted as
     ICI-reasonable.  On multi-host slices where jax.devices() enumerates
     by (process, local ordinal) but the physical torus differs,
@@ -78,13 +87,18 @@ def make_hybrid_mesh() -> Mesh:
     there; this helper prefers the simple order that is provably
     slice-contiguous and unit-testable (slice_major_order).
     """
-    devices = jax.devices()
-    slice_ids = [getattr(d, "slice_index", 0) for d in devices]
+    if devices is None:
+        devices = jax.devices()
+    if slice_ids is None:
+        slice_ids = [getattr(d, "slice_index", 0) for d in devices]
+    if len(slice_ids) != len(devices):
+        raise ValueError(
+            f"{len(slice_ids)} slice ids for {len(devices)} devices")
     if len(set(slice_ids)) > 1:
         order = slice_major_order(slice_ids)
         return Mesh(np.asarray([devices[i] for i in order]),
                     (PARTITION_AXIS,))
-    return make_mesh()
+    return Mesh(np.asarray(list(devices)), (PARTITION_AXIS,))
 
 
 def make_mesh_2d(
@@ -167,18 +181,20 @@ def solve_dense_sharded(
     # monkeypatch-visible (tests patch tensor-module attributes).
     from ..plan import tensor as _tensor
 
+    # Resolve against the PER-SHARD slice: each device holds P/n_shards
+    # rows (x N/node_shards columns) of every [P, N] intermediate, so
+    # that is the working set the chip must fit.  None = follow the
+    # module default, same as the single-chip entry points
+    # (plan_next_map_tpu, PlannerSession.replan) — a caller who never
+    # touches knobs gets "auto" on every path; both resolvers pass
+    # explicit modes through untouched.
+    shard_p = -(-prev.shape[0] // n_shards)
+    shard_n = -(-np.asarray(nweights).shape[-1] // node_shards)
     if fused_score is None:
-        # None = follow the module default, same as the single-chip entry
-        # points (plan_next_map_tpu, PlannerSession.replan) — a caller
-        # who never touches knobs gets "auto" on every path.
-        fused_score = _tensor._FUSED_SCORE_DEFAULT
-    if fused_score == "auto":
-        # Resolve against the PER-SHARD slice: each device holds
-        # P/n_shards rows (x N/node_shards columns) of every [P, N]
-        # intermediate, so that is the working set the chip must fit.
+        fused_score = _tensor.resolve_default_fused_score(shard_p, shard_n)
+    else:
         fused_score = _tensor.resolve_fused_score(
-            "auto", -(-prev.shape[0] // n_shards),
-            -(-np.asarray(nweights).shape[-1] // node_shards))
+            fused_score, shard_p, shard_n)
 
     prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
     pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
