@@ -39,7 +39,7 @@ from jax.experimental import pallas as pl
 # would silently recompile rather than retune.
 
 
-def _tile_env(name: str, default: int) -> int:
+def _tile_env(name: str, default: int, multiple: int) -> int:
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -49,11 +49,17 @@ def _tile_env(name: str, default: int) -> int:
         raise ValueError(f"{name}={raw!r} is not an integer") from None
     if v < 1:
         raise ValueError(f"{name}={v} must be >= 1")
+    if v % multiple:
+        # An unaligned tile dies deep inside Mosaic with an opaque
+        # lowering error; reject it here with the env var's name instead.
+        raise ValueError(
+            f"{name}={v} must be a multiple of {multiple} (TPU "
+            f"sublane/lane alignment)")
     return v
 
 
-_TILE_P = _tile_env("BLANCE_FUSED_TILE_P", 256)
-_TILE_N = _tile_env("BLANCE_FUSED_TILE_N", 2048)
+_TILE_P = _tile_env("BLANCE_FUSED_TILE_P", 256, 8)
+_TILE_N = _tile_env("BLANCE_FUSED_TILE_N", 2048, 128)
 
 __all__ = ["fused_score_min2", "ScoreInputs", "pack_score_inputs",
            "score_at_columns", "jitter_hash"]

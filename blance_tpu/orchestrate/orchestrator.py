@@ -111,7 +111,7 @@ class OrchestratorProgress:
         # One snapshot per progress event: a shallow __dict__ copy is
         # ~4x cheaper than dataclasses.replace (which re-runs __init__
         # over all 20 fields); only `errors` needs its own list.
-        new = object.__new__(OrchestratorProgress)
+        new = object.__new__(type(self))  # keep subclass snapshots typed
         new.__dict__.update(self.__dict__)
         new.errors = list(self.errors)
         return new
