@@ -304,6 +304,9 @@ prev, nodes, removed = bench._make_map(P, N)
 m = model(primary=(0, 1), replica=(1, 1))
 opts = bench._rack_opts(nodes)
 opts.max_iterations = 1  # single pass, same work as one solve
+# Epoch marker AFTER imports + problem construction: a timed-out parent
+# bounds PLANNER time from here, not from process start.
+print("SETUP_DONE", time.time(), flush=True)
 t0 = time.perf_counter()
 plan_next_map(prev, prev, nodes, removed, [], m, opts, backend=backend)
 print(json.dumps({{"cpu_s": time.perf_counter() - t0}}))
@@ -330,15 +333,28 @@ def bench_cpu(P, N):
         P=cpu_p, N=N, backend=backend)
     log(f"[{P}x{N}] cpu {backend} @ {cpu_p}x{N} (full-size measurement, "
         f"timeout {CPU_TIMEOUT_S}s)...")
-    t0 = time.perf_counter()
     try:
         r = subprocess.run([sys.executable, "-c", child],
                            timeout=CPU_TIMEOUT_S, capture_output=True,
                            text=True, check=True)
         cpu_s = json.loads(r.stdout.strip().splitlines()[-1])["cpu_s"]
         bound = False
-    except subprocess.TimeoutExpired:
-        cpu_s = time.perf_counter() - t0  # elapsed budget = lower bound
+    except subprocess.TimeoutExpired as e:
+        # Lower-bound the PLANNER time only: the child stamps wall time
+        # after imports + problem construction, so the bound excludes
+        # startup.  No marker captured (killed during setup) = no claim.
+        out = e.stdout or ""
+        if isinstance(out, bytes):  # text= capture varies across versions
+            out = out.decode(errors="replace")
+        marker = None
+        for line in out.splitlines():
+            if line.startswith("SETUP_DONE"):
+                marker = float(line.split()[1])
+        if marker is None:
+            log(f"[{P}x{N}] cpu baseline timed out during setup; "
+                f"no measurement")
+            return {"cpu_s": None, "baseline": f"{backend}-timeout"}
+        cpu_s = time.time() - marker
         bound = True
     except (subprocess.CalledProcessError, ValueError, KeyError,
             IndexError) as e:

@@ -27,6 +27,37 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# The fast-CI tier (pytest -m smoke): every data-model / moves / planner
+# golden module plus the cheap orchestrator goldens — the suites most
+# likely to catch a regression per second of runtime.  The heavy tiers
+# (fuzz parametrizations, 8-device sharding, orchestrator stress, Pallas
+# interpret runs) stay full-suite-only.  Module-level so the list is one
+# place, applied at collection time.
+SMOKE_MODULES = {
+    "test_setops",
+    "test_hierarchy",
+    "test_moves",
+    "test_moves_batch",
+    "test_marshal",
+    "test_plan_helpers",
+    "test_plan",
+    "test_control",
+    "test_rebalance",
+    "test_orchestrate",
+    "test_plan_vis",
+    "test_plan_hierarchy",
+    "test_session",
+    "test_native",
+    "test_ops_reduce2",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = item.nodeid.split("::", 1)[0].rsplit("/", 1)[-1]
+        if module.removesuffix(".py") in SMOKE_MODULES:
+            item.add_marker(pytest.mark.smoke)
+
 
 def planner_backends():
     """Parametrize golden suites over the exact planner backends: the
