@@ -1,0 +1,84 @@
+"""Scheduler-throughput measurement for BASELINE.md's orchestrator table.
+
+Drives the asyncio CSP orchestrator with a no-op assign callback (data
+plane instant, so scheduling overhead is the whole cost) in BOTH
+semantics modes:
+
+  - interrupt_on_first_feed=True  — the DEFAULT, reference-fidelity mode
+    (re-runs move selection after every accepted feed,
+    /root/reference/orchestrate.go:566-580)
+  - interrupt_on_first_feed=False — throughput mode (commit the whole
+    feasible batch per round)
+
+Usage: python docs/bench_scheduler.py [--quick]
+Prints one JSON line per (mode, size) with ops/s.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from blance_tpu import Partition, PartitionModelState
+from blance_tpu.orchestrate import OrchestratorOptions, orchestrate_moves
+
+MODEL = {
+    "primary": PartitionModelState(priority=0, constraints=1),
+    "replica": PartitionModelState(priority=1, constraints=1),
+}
+
+
+def shifted_maps(P, nodes):
+    """Every partition moves primary/replica one node to the right."""
+    beg, end = {}, {}
+    n = len(nodes)
+    for i in range(P):
+        name = str(i)
+        beg[name] = Partition(name, {"primary": [nodes[i % n]],
+                                     "replica": [nodes[(i + 1) % n]]})
+        end[name] = Partition(name, {"primary": [nodes[(i + 1) % n]],
+                                     "replica": [nodes[(i + 2) % n]]})
+    return beg, end
+
+
+async def drive(options, beg, end, nodes, counter):
+    def assign(stop_ch, node, partitions, states, ops):
+        counter[0] += len(partitions)
+        return None
+
+    o = orchestrate_moves(MODEL, options, nodes, beg, end, assign)
+    async for _ in o.progress_ch():
+        pass
+    o.stop()
+
+
+def measure(P, N, interrupt):
+    nodes = [f"n{i}" for i in range(N)]
+    beg, end = shifted_maps(P, nodes)
+    counter = [0]
+    opts = OrchestratorOptions(
+        max_concurrent_partition_moves_per_node=4,
+        interrupt_on_first_feed=interrupt)
+    t0 = time.perf_counter()
+    asyncio.run(drive(opts, beg, end, nodes, counter))
+    dt = time.perf_counter() - t0
+    row = {"P": P, "N": N,
+           "mode": "default" if interrupt else "throughput",
+           "interrupt_on_first_feed": interrupt,
+           "ops": counter[0], "seconds": round(dt, 2),
+           "ops_per_s": round(counter[0] / dt)}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    sizes = [(1000, 50)] if args.quick else [(8_000, 200), (32_000, 800)]
+    for P, N in sizes:
+        for interrupt in (True, False):
+            measure(P, N, interrupt)
