@@ -475,36 +475,46 @@ def _pin_prev_holders(
     cap: jnp.ndarray,  # [N] GLOBAL capacity for this state
     slack: jnp.ndarray,  # [P] per-holder capacity tolerance (stickiness)
     axis_name: Optional[str],
+    load_div: Optional[jnp.ndarray] = None,  # [N] node weight (>= 1) —
+    # converts held weight into the score's count/weight units
+    taken_stack: Optional[jnp.ndarray] = None,  # [P, T] GLOBAL node ids
+    # this row's partition already occupies (other states / ordinals)
 ) -> jnp.ndarray:
     """Capacity-capped warm start: returns pinned[P] bool.
 
-    Eligible previous holders keep their node up to its capacity plus the
-    holder's stickiness ``slack``, in partition order (deterministic).  The
-    slack is what makes replanning a FIXPOINT: a fresh plan can leave a
-    node one unit over the ceil capacity (first-bidder progress rule), and
-    the reference's semantics keep a sticky holder unless moving improves
-    balance by more than its stickiness (plan.go:654-662) — so pins
-    tolerate the same overshoot instead of churning it.  The same marginal
-    rule cuts the other way: when some node is under-loaded by MORE than
-    the stickiness (a fresh node joining), moving there is profitable and
-    the slack switches off, so growth still migrates load.  The first
-    holder per node always stays (auction progress rule).  Everything else
-    goes to the auction.
+    The keep-ceiling per node is  max(fair-share quota,
+    (least-loaded-open-node's score-load + stickiness) * node_weight) —
+    the batch spelling of the reference's marginal rule (plan.go:654-662
+    + the traced self-inclusive count: a holder keeps its node iff its
+    node's load minus stickiness still beats the emptiest candidate).
+    Consequences, each pinned by a test: a fresh node pulls load only
+    from nodes more than ``stickiness`` above it (2 copies + 1 fresh
+    node -> one moves); +-1 capacity-quantization fixpoints replan
+    unchanged (ceil-cap overshoot sits inside the lmin+stickiness
+    band); delta rebalances shed only the load above the band instead
+    of trimming every over-quota node to its exact share (churn stays
+    near the sequential oracle's).  Holders are kept in partition order
+    (deterministic), except that holders barred from the emptiest node
+    by same-partition exclusivity keep their place first (see trim).
+    The first holder per node always stays (auction progress rule).
+    Everything else goes to the auction.
     """
     p = prev_slot.shape[0]
     n = cap.shape[0]
     safe = _drop_empty(prev_slot, n)
     pin_w = jnp.where(pin_ok, pweights, 0.0)
     node_w_local = jnp.zeros(n, jnp.float32).at[safe].add(pin_w, mode="drop")
-    # Deficit and over-capacity are GLOBAL questions — under shard_map each
+    # Load and over-capacity are GLOBAL questions — under shard_map each
     # shard holds an arbitrary subset of a node's holders, so the shard-
     # local weight says nothing about whether the node is full.
     node_w = _psum(node_w_local, axis_name)
-    # Deficit of the emptiest node (removed nodes have cap 0, so they
-    # can't fake one).  Holders whose stickiness is below it lose their
-    # slack — the auction will fill that node with them.
-    max_deficit = jnp.max(cap - node_w) if n else jnp.float32(0)
-    slack = jnp.where(max_deficit > slack, 0.0, slack)
+    # Least-loaded OPEN node in score units (held weight / node weight);
+    # the minimum runs over nodes that can accept load (cap > 0 —
+    # removed nodes can't fake an empty target).  Anchors the marginal
+    # keep-ceiling below.
+    div = load_div if load_div is not None else jnp.ones(n, jnp.float32)
+    load = node_w / div
+    lmin = jnp.min(jnp.where(cap > 0, load, jnp.inf)) if n else jnp.inf
 
     # The trim quota must be shard-local (each shard admits only its
     # integral share of a node's capacity, remainder rotated — the same
@@ -519,13 +529,36 @@ def _pin_prev_holders(
 
     def trim(_):
         # Some node over-caps (cluster grew, its share shrank): keep
-        # holders in partition order up to capacity + slack.
+        # holders up to the marginal ceiling.  Within a node group, holders
+        # whose partition already occupies the EMPTIEST open node are
+        # kept FIRST: exclusivity bars their displaced copy from the one
+        # node that needs load, so displacing them instead of a free
+        # holder strands the deficit (seen on a 2-node + fresh-node
+        # grow: both capacity-displaced primaries landed on the new
+        # node, so the replica wave's partition-order trim displaced
+        # exactly the two replicas that could not follow).  Ties keep
+        # partition order (deterministic).
+        if taken_stack is not None:
+            deficit_node = jnp.argmin(jnp.where(cap > 0, load, jnp.inf))
+            blocked = jnp.any(taken_stack == deficit_node, axis=1)
+            perm1 = jnp.argsort((~blocked).astype(jnp.int32), stable=True)
+        else:
+            perm1 = jnp.arange(p)
         sort_node = jnp.where(pin_ok, prev_slot, n)
-        perm = jnp.argsort(sort_node, stable=True)  # groups by node
+        perm2 = jnp.argsort(sort_node[perm1], stable=True)  # groups by node
+        perm = perm1[perm2]
         node_s = sort_node[perm]
         ok_s = pin_ok[perm]
         w_s = jnp.where(ok_s, pweights[perm], 0.0)
-        cap_here = cap_quota[jnp.clip(node_s, 0, n - 1)] + slack[perm]
+        # Marginal keep-ceiling (docstring): fair-share quota, or the
+        # emptiest open node's load plus the holder's stickiness in the
+        # node's weight units — whichever is larger.  The lmin band is
+        # divided by the shard count like the quota: it is a GLOBAL
+        # allowance, and each shard orders only its own holders.
+        ns = lax.axis_size(axis_name) if axis_name else 1
+        nclip = jnp.clip(node_s, 0, n - 1)
+        band = (lmin + slack[perm]) * div[nclip] / ns
+        cap_here = jnp.maximum(cap_quota[nclip], band)
         keep_s = _segment_accept(node_s, ok_s, w_s, cap_here)
         return jnp.zeros(p, jnp.bool_).at[perm].set(keep_s)
 
@@ -613,14 +646,18 @@ def _assign_slot(
         best, choice, second, raw_choice = min2_fn(price_vec)
         margin = jnp.clip(jnp.nan_to_num(second - best, posinf=10.0), 0.0, 10.0)
 
-        # Rules-first gate (mirrors phase B's soft_ok): when every
-        # rule-satisfying node is priced closed — common under shard_map,
-        # where each shard holds only 1/ns of a node's capacity — the
-        # priced argmin falls through to a rule-missing node.  Don't bid
-        # it: wait for capacity-ignoring force, which prefers the
-        # satisfying nodes (rule conformance beats balance, like the
-        # reference's hierarchy-pass-first ordering, plan.go:174-226).
-        rule_ok = ((raw_choice < _RULE_MISS / 2)
+        # Rules-first gate (mirrors phase B's soft_ok): when every node
+        # at the partition's best attainable rule TIER is priced closed
+        # — common under shard_map, where each shard holds only 1/ns of
+        # a node's capacity — the priced argmin falls through to a
+        # worse-tier node.  Don't bid it: wait for top-up/force, which
+        # prefer the best-tier nodes (rule conformance beats balance,
+        # like the reference's hierarchy-pass-first ordering,
+        # plan.go:174-226).  Tier equality is a band test against the
+        # unpriced row-min: within-tier terms stay far below the
+        # _RULE_TIER step.  Unattainable rules (row-min at _RULE_MISS)
+        # fall back flat and accept any feasible node.
+        rule_ok = ((raw_choice < raw_best_all + _RULE_TIER * 0.5)
                    | (raw_best_all >= _RULE_MISS / 2)) if has_rules else True
         active = unassigned & (best < _INF / 2) & rule_ok
 
@@ -676,7 +713,11 @@ def _assign_slot(
 
         raw2 = score_at_fn(sperm, choice2)
         hard_ok = raw2 < _INF / 2
-        soft_ok = ((raw2 < _RULE_MISS / 2)
+        # Same tier-aware gate as phase A: the waterfall may only place a
+        # partition at its best attainable tier — a capacity-ordered
+        # target at a worse tier is skipped and retried next round (the
+        # audit counts any tier downgrade as a hierarchy miss).
+        soft_ok = ((raw2 < raw_best_all[sperm] + _RULE_TIER * 0.5)
                    | (raw_best_all[sperm] >= _RULE_MISS / 2)) \
             if has_rules else True
         accept2_s = s_mask & in_range & hard_ok & soft_ok
@@ -841,7 +882,15 @@ def solve_dense(
     # spread 12-20 vs 15-17 at 256x16).
     jitter_scale = jnp.float32(_JITTER)
 
-    cap_w = jnp.where(valid, jnp.maximum(nweights, 1.0), 0.0)
+    # Negative-weight (booster-steered) nodes get NO fair-share capacity:
+    # the cbgt booster semantics make them last-resort targets (greedy
+    # adds max(-w, stickiness) to their score, plan.go:675-684), so the
+    # rail must not reserve a share for them — new load overflows onto
+    # them only through the capacity-ignoring force step.  Their existing
+    # sticky holders survive via pin slack when -w <= stickiness (the
+    # same marginal rule the greedy applies).
+    cap_w = jnp.where(valid & (nweights >= 0), jnp.maximum(nweights, 1.0),
+                      0.0)
     cap_share = cap_w / jnp.maximum(jnp.sum(cap_w), 1.0)
 
     # Seed the total-fill factor from prev (plan.go:94).  Per-state counts
@@ -901,7 +950,11 @@ def solve_dense(
         taken_prev = jnp.stack(
             [_in_id_list(prev_k[:, j], taken_ids) for j in range(kk)],
             axis=1)
-        pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken_prev
+        # Booster-steered nodes: a holder stays only while the boost does
+        # not exceed its stickiness (greedy: +max(-w, stick) - stick <= 0
+        # keeps, > 0 pushes off, plan.go:675-684 + the cbgt booster).
+        pin_ok_k = (prev_k >= 0) & valid[safe_k] & ~taken_prev & \
+            (neg_boost[safe_k] <= stickiness[:, si][:, None])
         # An externally supplied prev map can repeat a node within one
         # state's row; only the first occurrence may pin, or both copies
         # would keep the same node — a duplicate the auction's exclusivity
@@ -967,6 +1020,9 @@ def solve_dense(
             state_cap,
             jnp.repeat(stickiness[:, si], kk),
             axis_name,
+            load_div=w_div,
+            taken_stack=(jnp.repeat(jnp.stack(taken_ids, axis=1), kk, axis=0)
+                         if taken_ids else None),
         )
         pins = pins_flat.reshape(p, kk)
         # Same-partition exclusivity: later ordinals' pins must be visible
