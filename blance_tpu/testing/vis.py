@@ -28,7 +28,8 @@ from ..core.types import (
 )
 from ..plan.api import plan_next_map
 
-__all__ = ["VisCase", "parse_vis_row", "vis_maps", "run_vis_cases", "format_vis_map"]
+__all__ = ["VisCase", "parse_vis_row", "vis_maps", "run_vis_cases",
+           "format_vis_map", "assert_contract"]
 
 _STATE_NAMES = {"m": "primary", "s": "replica"}
 
@@ -107,11 +108,93 @@ def vis_maps(case: VisCase) -> tuple[PartitionMap, PartitionMap]:
     return prev_map, exp_map
 
 
-def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
-    """Plan each case and assert the golden map + warning count.
+def _weighted_state_spread(
+    pmap: PartitionMap, model: PartitionModel, nodes: list[str],
+    node_weights, partition_weights,
+) -> dict[str, float]:
+    """Per state: max-min of partition-weighted load / node weight over
+    ``nodes`` — the quantity the planners balance (plan.go:94)."""
+    nw = node_weights or {}
+    pw = partition_weights or {}
+    out: dict[str, float] = {}
+    for st in model:
+        loads = {n: 0.0 for n in nodes}
+        for pname, p in pmap.items():
+            w = pw.get(pname, 1)
+            for n in p.nodes_by_state.get(st, []):
+                if n in loads:
+                    loads[n] += w
+        vals = [loads[n] / max(nw.get(n, 1), 1) for n in nodes]
+        out[st] = max(vals) - min(vals) if vals else 0.0
+    return out
 
-    ``backend`` overrides every case's backend — how the golden suites
-    run against each exact planner implementation (greedy / native)."""
+
+def assert_contract(
+    label: str,
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    exp_map: PartitionMap,
+    result: PartitionMap,
+    nodes: list[str],
+    nodes_to_remove: list[str],
+    model: PartitionModel,
+    opts: PlanOptions,
+) -> None:
+    """Contract-mode assertions for the batched (tpu) backend: the solver
+    is deliberately not bit-identical to the sequential greedy (it solves
+    globally), so the golden corpus asserts the properties that matter
+    instead of the exact map: ZERO audit violations (duplicates, removed
+    nodes, unfilled feasible slots, feasible-tier hierarchy misses) and
+    per-state weighted balance within the golden oracle's spread + 1."""
+    import numpy as np
+
+    from ..core.encode import encode_problem
+    from ..plan.tensor import check_assignment
+
+    problem = encode_problem(prev_map, partitions_to_assign, nodes,
+                             nodes_to_remove, model, opts)
+    r_max = max([problem.R, 1] + [
+        len(ns) for p in result.values()
+        for ns in p.nodes_by_state.values()])
+    assign = np.full((problem.P, problem.S, r_max), -1, np.int32)
+    nidx = {n: j for j, n in enumerate(problem.nodes)}
+    sidx = {s: j for j, s in enumerate(problem.states)}
+    for pi, pname in enumerate(problem.partitions):
+        for s, ns in result[pname].nodes_by_state.items():
+            if s not in sidx:
+                continue  # unmodeled passthrough states aren't audited
+            for ri, node in enumerate(ns):
+                assign[pi, sidx[s], ri] = nidx[node]
+    counts = check_assignment(problem, assign)
+    assert not any(counts.values()), (
+        f"{label}: tpu contract violations {counts}:\n"
+        + "\n".join(format_vis_map(result, nodes)))
+
+    survivors = [n for n in nodes if n not in (nodes_to_remove or [])]
+    sp_got = _weighted_state_spread(
+        result, model, survivors, opts.node_weights, opts.partition_weights)
+    sp_exp = _weighted_state_spread(
+        exp_map, model, survivors, opts.node_weights, opts.partition_weights)
+    # Slack: placements are integral in partition-weight units (a single
+    # differently-placed copy moves the spread by its weight) plus one
+    # unit for the auction's first-bidder progress overshoot.
+    wmax = max((opts.partition_weights or {}).values(), default=1)
+    for st in model:
+        assert sp_got[st] <= sp_exp[st] + wmax + 1, (
+            f"{label}: state {st} spread {sp_got[st]} "
+            f"vs golden oracle {sp_exp[st]} (+{wmax}+1):\n"
+            + "\n".join(format_vis_map(result, nodes)))
+
+
+def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
+    """Plan each case and assert expectations.
+
+    ``backend`` overrides every case's backend.  The exact planners
+    (greedy / native) assert the golden map bit-for-bit; the batched
+    "tpu" backend asserts CONTRACT properties instead (_assert_contract)
+    plus the same warnings-count equality — the reference's curated hard
+    cases (plan_test.go:1746-2863) pointed at the solver that is not
+    meant to be bit-identical."""
     for i, case in enumerate(cases):
         if case.ignore:
             continue
@@ -124,6 +207,7 @@ def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
             node_hierarchy=case.node_hierarchy,
             hierarchy_rules=case.hierarchy_rules,
         )
+        resolved = backend or case.backend
         result, warnings = plan_next_map(
             prev_map,
             prev_map,
@@ -132,17 +216,23 @@ def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
             case.nodes_to_add,
             case.model,
             opts,
-            backend=backend or case.backend,
+            backend=resolved,
         )
         cell_length = 2 if case.from_to_priority else 1
-        got = {name: p.nodes_by_state for name, p in result.items()}
-        exp = {name: p.nodes_by_state for name, p in exp_map.items()}
-        assert got == exp, (
-            f"case {i} ({case.about}):\n"
-            f"got:\n" + "\n".join(format_vis_map(result, case.nodes, cell_length))
-            + "\nexpected:\n"
-            + "\n".join(format_vis_map(exp_map, case.nodes, cell_length))
-        )
+        if resolved == "tpu":
+            assert_contract(
+                f"case {i} ({case.about})", prev_map, prev_map, exp_map,
+                result, case.nodes, case.nodes_to_remove, case.model, opts)
+        else:
+            got = {name: p.nodes_by_state for name, p in result.items()}
+            exp = {name: p.nodes_by_state for name, p in exp_map.items()}
+            assert got == exp, (
+                f"case {i} ({case.about}):\n"
+                f"got:\n"
+                + "\n".join(format_vis_map(result, case.nodes, cell_length))
+                + "\nexpected:\n"
+                + "\n".join(format_vis_map(exp_map, case.nodes, cell_length))
+            )
         assert len(warnings) == case.exp_num_warnings, (
             f"case {i} ({case.about}): warnings {warnings} "
             f"expected {case.exp_num_warnings} partitions-with-warnings"

@@ -60,9 +60,13 @@ def pytest_collection_modifyitems(config, items):
 
 
 def planner_backends():
-    """Parametrize golden suites over the exact planner backends: the
-    Python greedy oracle and the native C++ core, which must be
-    bit-identical on every golden case (native.py's stated contract)."""
+    """Parametrize golden suites over every planner backend: the Python
+    greedy oracle and the native C++ core run the goldens bit-for-bit
+    (native.py's stated contract); the batched "tpu" backend runs the
+    same corpus in CONTRACT mode (testing/vis.py _assert_contract: zero
+    audit violations, weighted balance within the golden oracle + 1,
+    warnings-count equality) — it solves globally and is deliberately
+    not bit-identical."""
     from blance_tpu.plan.native import native_available
 
     return [
@@ -70,4 +74,5 @@ def planner_backends():
         pytest.param("native", marks=pytest.mark.skipif(
             not native_available(),
             reason="native toolchain unavailable")),
+        "tpu",
     ]

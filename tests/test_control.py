@@ -23,6 +23,38 @@ def nbs(result):
     return {name: p.nodes_by_state for name, p in result.items()}
 
 
+def _boost_mass(result, node_weights):
+    """Total boosted-away weight carried: sum of max(0, -w) per placed
+    copy — the quantity the booster exists to minimize."""
+    return sum(
+        max(0, -(node_weights or {}).get(n, 1))
+        for p in result.values()
+        for ns in p.nodes_by_state.values()
+        for n in ns)
+
+
+def check(backend, result, exp, prev, parts, nodes, remove, add, opts):
+    """Exact-map equality on the exact backends; on the batch (tpu)
+    backend, the POLICY contract instead: clean audit, balance within
+    the golden's band, and boosted-node avoidance at least as good as
+    the golden's (the batch solver is deliberately not bit-identical —
+    see testing/vis.py assert_contract)."""
+    if backend != "tpu":
+        assert nbs(result) == exp
+        return
+    from blance_tpu.testing.vis import assert_contract
+
+    exp_map = {k: Partition(k, {s: list(v) for s, v in d.items()})
+               for k, d in exp.items()}
+    assert_contract("control", prev, parts, exp_map, result, nodes,
+                    remove or [], M, opts)
+    got_mass = _boost_mass(result, opts.node_weights)
+    exp_mass = _boost_mass(exp_map, opts.node_weights)
+    assert got_mass <= exp_mass, (
+        f"tpu placement carries boost mass {got_mass} > golden's "
+        f"{exp_mass}: {nbs(result)}")
+
+
 @pytest.mark.parametrize("backend", planner_backends())
 def test_control_case1_pin_primary_to_c_replica_to_b(backend):
     parts = {"X": Partition("X", {})}
@@ -35,7 +67,10 @@ def test_control_case1_pin_primary_to_c_replica_to_b(backend):
         backend=backend,
     )
     assert not warnings
-    assert nbs(r) == {"X": {"primary": ["c"], "replica": ["b"]}}
+    check(backend, r, {"X": {"primary": ["c"], "replica": ["b"]}},
+          {}, parts, ["a", "b", "c", "d", "e"], None, None,
+          PlanOptions(node_weights={"a": -2, "b": -1, "d": -2, "e": -2},
+                      node_score_booster=cbgt_booster))
 
 
 @pytest.mark.parametrize("backend", planner_backends())
@@ -51,11 +86,12 @@ def test_control_case2_no_relocation_on_node_add(backend):
         backend=backend,
     )
     assert not warnings
-    assert nbs(r) == {
+    check(backend, r, {
         "X": {"primary": ["a"], "replica": ["b"]},
         "Y": {"primary": ["b"], "replica": ["a"]},
         "Z": {"primary": ["a"], "replica": ["b"]},
-    }
+    }, {}, parts, ["a", "b"], None, ["c"],
+        PlanOptions(node_score_booster=cbgt_booster))
 
 
 @pytest.mark.parametrize("backend", planner_backends())
@@ -74,11 +110,13 @@ def test_control_case3_steer_new_partition(backend):
         backend=backend,
     )
     assert not warnings
-    assert nbs(r) == {
+    check(backend, r, {
         "X": {"primary": ["a"], "replica": ["b"]},
         "Y": {"primary": ["b"], "replica": ["a"]},
         "Z": {"primary": ["b"], "replica": ["a"]},
-    }
+    }, {}, parts, ["a", "b", "c"], None, None,
+        PlanOptions(node_weights={"c": -3, "a": -1},
+                    node_score_booster=cbgt_booster))
 
 
 @pytest.mark.parametrize("backend", planner_backends())
@@ -99,7 +137,11 @@ def test_control_case4_hierarchy_plus_booster(backend):
         backend=backend,
     )
     assert not warnings
-    assert nbs(r) == {
+    check(backend, r, {
         "X": {"primary": ["a"], "replica": ["b"]},
         "Y": {"primary": ["b"], "replica": ["a"]},
-    }
+    }, prev, parts, ["a", "b"], None, None,
+        PlanOptions(node_weights={"a": -1, "b": -1},
+                    node_hierarchy={"a": "Group 1", "b": "Group 2"},
+                    hierarchy_rules={"replica": [HierarchyRule(2, 1)]},
+                    node_score_booster=cbgt_booster))

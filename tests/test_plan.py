@@ -229,8 +229,19 @@ def test_plan_next_map(case, backend):
         pm(case["prev"]), pm(case["assign"]), case["nodes"],
         case["remove"], case["add"], case["model"], opts, backend=backend,
     )
-    got = {name: p.nodes_by_state for name, p in result.items()}
-    exp = {name: dict(nbs) for name, nbs in case["exp"].items()}
-    assert got == exp, f"{case['about']}: got {got}, exp {exp}"
+    if backend == "tpu":
+        # The batched solver is deliberately not bit-identical; assert
+        # the contract (clean audit, balance within the golden's + 1)
+        # instead of the exact map — see testing/vis.py assert_contract.
+        from blance_tpu.testing.vis import assert_contract
+
+        assert_contract(
+            case["about"], pm(case["prev"]), pm(case["assign"]),
+            pm(case["exp"]), result, case["nodes"], case["remove"],
+            case["model"], opts)
+    else:
+        got = {name: p.nodes_by_state for name, p in result.items()}
+        exp = {name: dict(nbs) for name, nbs in case["exp"].items()}
+        assert got == exp, f"{case['about']}: got {got}, exp {exp}"
     total = sum(len(w) for w in warnings.values())
     assert total == case["warnings"], f"{case['about']}: warnings {warnings}"
