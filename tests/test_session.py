@@ -139,3 +139,33 @@ def test_load_map_rejects_unknown_partitions():
     s = fresh_session()
     with pytest.raises(ValueError, match="ghost"):
         s.load_map({"ghost": Partition("ghost", {})})
+
+
+def test_session_on_mesh_full_loop():
+    """PlannerSession(mesh=...) routes every replan through the sharded
+    solver: the steady loop (plan -> apply -> remove -> replan) must
+    produce audit-clean assignments, drain removed nodes, and keep the
+    map materialization working — the long-lived multichip deployment
+    shape (SURVEY §2.6)."""
+    from blance_tpu.parallel.sharded import make_mesh
+
+    s = PlannerSession(MODEL, NODES, PARTS, mesh=make_mesh(8))
+    a1 = s.replan()
+    assert (a1[:, 0, 0] >= 0).all() and (a1[:, 1, 0] >= 0).all()
+    counts = check_assignment(s.problem, a1)
+    assert not any(counts.values()), counts
+    s.apply()
+
+    s.remove_nodes(["n0"])
+    a2 = s.replan()
+    assert not (a2 == 0).any(), "copies left on the removed node id 0"
+    counts = check_assignment(s.problem, a2)
+    assert not any(counts.values()), counts
+    # Stickiness through the mesh path: untouched partitions stay put.
+    touched = (a1 == 0).any(axis=(1, 2))
+    churned = (a2 != a1).any(axis=(1, 2))
+    assert (churned & ~touched).sum() <= len(PARTS) * 0.2
+    nmap, warn = s.to_map("proposed")
+    assert not warn
+    assert all("n0" not in ns for p in nmap.values()
+               for ns in p.nodes_by_state.values())
