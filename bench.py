@@ -19,6 +19,15 @@ The compiled Pallas min2/argmin kernel (the auction's hot op) is verified
 against the XLA reference spelling on a real device batch before timing;
 the result ships in the JSON as pallas/pallas_verified.
 
+Observability (blance_tpu.obs): the run ends with a small end-to-end
+plan -> moves -> orchestrate pipeline stage, and the emitted JSON carries
+an "obs" block — per-phase span totals, counters (solver sweeps, engine
+fallbacks), and histogram p50/p95 summaries including per-move latency.
+``--trace-out PATH`` additionally captures every span into a Chrome
+trace-event file (open in chrome://tracing or https://ui.perfetto.dev);
+``--device-trace-dir DIR`` wraps the run in jax.profiler's device trace
+over the same interval so host spans and TPU traces line up.
+
 Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", "detail": {...}}
 plus human-readable detail on stderr.
@@ -26,6 +35,7 @@ plus human-readable detail on stderr.
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -161,14 +171,17 @@ def bench_tpu(P, N, fused=False):
 
     # block_until_ready is unreliable on the experimental axon platform, so
     # force completion with a small host copy ([P] primaries).
-    def run():
+    # record=False in the timed loop: the obs sweeps read is one extra
+    # scalar D2H round-trip, which would perturb ms-scale timings over the
+    # tunnel.  The compile call records once, so the counter still moves.
+    def run(record=False):
         out = solve_dense_converged(*dev_args, constraints, rules,
-                                    fused_score=mode)
+                                    fused_score=mode, record=record)
         np.asarray(out[:, 0, 0])
         return out
 
     t0 = time.perf_counter()
-    out = run()
+    out = run(record=True)
     compile_s = time.perf_counter() - t0
     log(f"{tag} compile+first-run: {compile_s:.2f}s")
 
@@ -287,6 +300,76 @@ def bench_phases(P, N):
     return phases
 
 
+def bench_pipeline(P=256, N=32):
+    """End-to-end plan -> moves -> orchestrate at a small fixed size.
+
+    This is the stage that exercises the moves and orchestrate layers, so
+    a --trace-out trace carries spans from the whole pipeline (plan
+    encode/solve/decode already come from bench_phases at bench scale)
+    and the obs histograms gain per-move latency (orchestrate.move.exec
+    with a no-op data plane: pure scheduling cost)."""
+    import asyncio
+
+    from blance_tpu import model
+    from blance_tpu.orchestrate.orchestrator import (
+        OrchestratorOptions, orchestrate_moves)
+    from blance_tpu.plan.api import plan_next_map
+
+    prev, nodes, removed = _make_map(P, N, seed=11)
+    m = model(primary=(0, 1), replica=(1, 1))
+    t0 = time.perf_counter()
+    next_map, _warn = plan_next_map(
+        prev, prev, nodes, removed, [], m, _rack_opts(nodes),
+        backend="greedy")
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)  # no data plane: scheduling cost only
+
+    async def run():
+        o = orchestrate_moves(
+            m,
+            OrchestratorOptions(device_diff=True,
+                                interrupt_on_first_feed=False,
+                                max_concurrent_partition_moves_per_node=4),
+            nodes, prev, next_map, assign)
+        events = 0
+        final = None
+        async for p in o.progress_ch():
+            events += 1
+            final = p
+        o.stop()
+        return events, final
+
+    events, final = asyncio.run(run())
+    total_ms = (time.perf_counter() - t0) * 1000
+    log(f"[pipeline {P}x{N}] plan+diff+orchestrate: {total_ms:.0f}ms, "
+        f"{final.tot_mover_assign_partition_ok} batches ok, "
+        f"{events} progress events")
+    return {"P": P, "N": N, "total_ms": round(total_ms, 1),
+            "batches_ok": final.tot_mover_assign_partition_ok,
+            "errors": len(final.errors),
+            "progress_events": events}
+
+
+def obs_summary():
+    """The Recorder's aggregates, floats rounded for the JSON artifact:
+    per-span-name totals (phase attribution), counters (solver sweeps,
+    fallbacks, orchestrator progress mirror), histogram p50/p95."""
+    from blance_tpu.obs import get_recorder
+
+    def r(x):
+        return round(x, 6) if isinstance(x, float) else x
+
+    s = get_recorder().summary()
+    return {
+        "spans": {k: {kk: r(vv) for kk, vv in v.items()}
+                  for k, v in s["spans"].items()},
+        "counters": {k: r(v) for k, v in s["counters"].items()},
+        "histograms": {k: {kk: r(vv) for kk, vv in v.items()}
+                       for k, v in s["histograms"].items() if v},
+    }
+
+
 # Child program for one CPU baseline measurement.  Runs in a subprocess so
 # the parent can enforce CPU_TIMEOUT_S (the native call is one C++ planner
 # invocation — uninterruptible in-process) and so the measurement can never
@@ -381,12 +464,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes (code-path test on CPU)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome trace-event JSON of every obs "
+                         "span (open in chrome://tracing / Perfetto)")
+    ap.add_argument("--device-trace-dir", default=None, metavar="DIR",
+                    help="also capture a jax.profiler device trace over "
+                         "the same interval (TensorBoard/Perfetto)")
     args = ap.parse_args()
 
-    global CONFIGS, RUNS
-    if args.smoke:
-        CONFIGS = [(512, 128, True), (512, 64, False)]  # headline first,
-        RUNS = 3                                        # like the real list
+    smoke = args.smoke
 
     # Fail fast if the device runtime is wedged: a hung tunnel makes
     # jax.devices() block forever inside native code (no Python timeout
@@ -396,7 +482,7 @@ def main():
     # doesn't work — the axon plugin overrides JAX_PLATFORMS), and that
     # in-process pin cannot propagate to a probe subprocess, which would
     # then hang against the very runtime smoke mode exists to avoid.
-    if not args.smoke:
+    if not smoke:
         import subprocess
 
         # Device wedges can be transient (a killed mid-compile client can
@@ -439,11 +525,49 @@ def main():
     import jax
 
     log(f"devices: {jax.devices()}")
+    if not smoke and jax.default_backend() == "cpu":
+        # No accelerator attached: the full configs would take hours of
+        # host time for numbers nobody should quote.  Degrade to smoke
+        # sizes (every code path still runs, incl. --trace-out capture)
+        # and say so — the artifact records the device either way.
+        log("no accelerator (jax backend is cpu): degrading to smoke "
+            "sizes; device numbers require a TPU host")
+        smoke = True
+
+    global CONFIGS, RUNS
+    if smoke:
+        CONFIGS = [(512, 128, True), (512, 64, False)]  # headline first,
+        RUNS = 3                                        # like the real list
+
+    if args.trace_out:
+        from blance_tpu.obs import trace
+
+        log(f"obs: capturing spans -> {args.trace_out}")
+        try:
+            # trace() validates the path up front and writes the file even
+            # when the run raises — a crashed run's trace is exactly the
+            # one worth reading.
+            with trace(args.trace_out,
+                       device_log_dir=args.device_trace_dir):
+                _run_benchmarks(smoke)
+        finally:
+            if os.path.exists(args.trace_out):
+                log(f"obs: chrome trace written to {args.trace_out}")
+    else:
+        from blance_tpu.utils.trace import device_profile
+
+        with device_profile(args.device_trace_dir):
+            _run_benchmarks(smoke)
+
+
+def _run_benchmarks(smoke):
+    import jax
+
     # Verify at the LARGEST node count benched (the headline shape),
     # regardless of config order.
     pallas, pallas_ok = verify_pallas(max(c[1] for c in CONFIGS))
 
-    fused_ok = not args.smoke and verify_fused_engine()
+    fused_ok = not smoke and verify_fused_engine()
 
     detail = {"configs": [], "pallas": pallas, "pallas_verified": pallas_ok,
               "fused_engine_verified": fused_ok,
@@ -534,8 +658,23 @@ def main():
             entry["vs_baseline"] = round(
                 entry["cpu_s"] * 1000 / entry["solve_ms_min"], 1)
         else:
-            entry["vs_baseline"] = 0.0  # baseline failed; tagged above
+            # Baseline failed (tagged in "baseline" above): an explicit
+            # null, never a 0.0 sentinel a dashboard could mistake for a
+            # measured "no speedup".
+            entry["vs_baseline"] = None
         save_progress(detail, f"cpu {entry['P']}x{entry['N']} done")
+
+    # Pipeline + metrics stage: exercise moves + orchestrate so the trace
+    # and the "obs" block cover every layer, then embed the recorder's
+    # aggregates (span totals, counters, histogram p50/p95 — including
+    # orchestrate.move_latency_s) into the artifact.
+    try:
+        detail["pipeline"] = bench_pipeline()
+    except Exception as e:  # attribution detail — must not eat the solve
+        log(f"pipeline stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["pipeline_error"] = first_line(e)
+    detail["obs"] = obs_summary()
+    save_progress(detail, "pipeline done")
 
     if headline is None:
         # The headline config failed outright on every engine; fall back

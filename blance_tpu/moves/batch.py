@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.types import PartitionMap, PartitionModel
+from ..obs import get_recorder
 from .calc import NodeStateOp
 
 __all__ = ["diff_assignments", "calc_all_moves", "OP_NAMES"]
@@ -156,8 +157,6 @@ def calc_all_moves(
     (cross-checked in tests); use this for 100k-partition rebalances where
     the host loop is the bottleneck.
     """
-    from ..plan.greedy import sort_state_names, sorted_by_partition_name
-
     if beg_map.keys() != end_map.keys():
         # The host path (orchestrate_moves) raises KeyError on a partition
         # missing from end_map; silently emitting del-everything here would
@@ -165,6 +164,20 @@ def calc_all_moves(
         missing = beg_map.keys() ^ end_map.keys()
         raise KeyError(
             f"beg_map/end_map partition sets differ: {sorted(missing)[:5]}")
+
+    rec = get_recorder()
+    with rec.span("moves.calc_all_moves", partitions=len(beg_map)):
+        return _calc_all_moves(beg_map, end_map, model, favor_min_nodes, rec)
+
+
+def _calc_all_moves(
+    beg_map: PartitionMap,
+    end_map: PartitionMap,
+    model: PartitionModel,
+    favor_min_nodes: bool,
+    rec,
+) -> dict[str, list[NodeStateOp]]:
+    from ..plan.greedy import sort_state_names, sorted_by_partition_name
 
     states = sort_state_names(model)
     state_index = {sname: i for i, sname in enumerate(states)}
@@ -182,80 +195,90 @@ def calc_all_moves(
             nodes.append(node)
         return node_index[node]
 
-    r_max = 1
-    for m in (beg_map, end_map):
-        for partition in m.values():
-            for sname, ns in partition.nodes_by_state.items():
-                if sname in state_index:
-                    r_max = max(r_max, len(ns))
+    with rec.span("moves.encode"):
+        r_max = 1
+        for m in (beg_map, end_map):
+            for partition in m.values():
+                for sname, ns in partition.nodes_by_state.items():
+                    if sname in state_index:
+                        r_max = max(r_max, len(ns))
 
-    P, S = len(names), len(states)
-    beg = np.full((P, S, r_max), -1, np.int32)
-    end = np.full((P, S, r_max), -1, np.int32)
-    # Partitions where a node appears in more than one state on either side
-    # need the host diff: the reference's per-state scan + seen-set has
-    # order-dependent behavior there that the dense one-state-per-node
-    # encoding cannot express (moves.go:49-58).
-    irregular: set[str] = set()
-    for pi, name in enumerate(names):
-        for arr, m in ((beg, beg_map), (end, end_map)):
-            partition = m[name]  # key equality enforced above
-            seen_nodes: set[str] = set()
-            for sname, ns in partition.nodes_by_state.items():
-                si = state_index.get(sname)
-                if si is None:
-                    continue
-                for ri, node in enumerate(ns[:r_max]):
-                    if node in seen_nodes:
-                        irregular.add(name)
-                    seen_nodes.add(node)
-                    arr[pi, si, ri] = intern(node)
+        P, S = len(names), len(states)
+        beg = np.full((P, S, r_max), -1, np.int32)
+        end = np.full((P, S, r_max), -1, np.int32)
+        # Partitions where a node appears in more than one state on either
+        # side need the host diff: the reference's per-state scan + seen-set
+        # has order-dependent behavior there that the dense
+        # one-state-per-node encoding cannot express (moves.go:49-58).
+        irregular: set[str] = set()
+        for pi, name in enumerate(names):
+            for arr, m in ((beg, beg_map), (end, end_map)):
+                partition = m[name]  # key equality enforced above
+                seen_nodes: set[str] = set()
+                for sname, ns in partition.nodes_by_state.items():
+                    si = state_index.get(sname)
+                    if si is None:
+                        continue
+                    for ri, node in enumerate(ns[:r_max]):
+                        if node in seen_nodes:
+                            irregular.add(name)
+                        seen_nodes.add(node)
+                        arr[pi, si, ri] = intern(node)
 
     if P == 0 or not nodes:
         return {name: [] for name in names}
 
-    # Pad P to the next power of two so repeated diffs of different-sized
-    # maps hit the jit cache (padding rows are all -1 -> zero ops).
-    p_pad = 1 << max(P - 1, 0).bit_length()
-    if p_pad != P:
-        pad = np.full((p_pad - P,) + beg.shape[1:], -1, np.int32)
-        beg = np.concatenate([beg, pad])
-        end = np.concatenate([end, pad])
+    rec.count("moves.diff_partitions", P)
+    rec.count("moves.irregular_partitions", len(irregular))
 
-    d_nodes, d_states, d_ops = diff_assignments(
-        jnp.asarray(beg), jnp.asarray(end), favor_min_nodes=favor_min_nodes)
-    d_nodes = np.asarray(d_nodes)[:P]
-    d_states = np.asarray(d_states)[:P]
-    d_ops = np.asarray(d_ops)[:P]
+    with rec.span("moves.device_diff", P=P, S=S, R=r_max):
+        # Pad P to the next power of two so repeated diffs of
+        # different-sized maps hit the jit cache (padding rows are all
+        # -1 -> zero ops).
+        p_pad = 1 << max(P - 1, 0).bit_length()
+        if p_pad != P:
+            pad = np.full((p_pad - P,) + beg.shape[1:], -1, np.int32)
+            beg = np.concatenate([beg, pad])
+            end = np.concatenate([end, pad])
+
+        d_nodes, d_states, d_ops = diff_assignments(
+            jnp.asarray(beg), jnp.asarray(end),
+            favor_min_nodes=favor_min_nodes)
+        d_nodes = np.asarray(d_nodes)[:P]
+        d_states = np.asarray(d_states)[:P]
+        d_ops = np.asarray(d_ops)[:P]
 
     from .calc import calc_partition_moves
 
-    # Materialize ops flat: valid entries sort to the front of each row
-    # (invalid keys are 2^30), so row pi's moves are its first counts[pi]
-    # flat entries.  One pass over the ~total-op count instead of P x L
-    # Python iterations.
-    mask = d_ops >= 0
-    counts = mask.sum(axis=1)
-    flat = mask.reshape(-1)
-    node_names = np.asarray(nodes, dtype=object)[d_nodes.reshape(-1)[flat]]
-    state_arr = np.asarray(states + [""], dtype=object)
-    state_names = state_arr[d_states.reshape(-1)[flat]]  # -1 wraps to ""
-    op_arr = np.asarray(OP_NAMES, dtype=object)
-    op_names = op_arr[d_ops.reshape(-1)[flat]]
-    flat_moves = [NodeStateOp(n_, s_, o_) for n_, s_, o_ in
-                  zip(node_names.tolist(), state_names.tolist(),
-                      op_names.tolist())]
-    offsets = np.zeros(P + 1, np.int64)
-    np.cumsum(counts, out=offsets[1:])
+    with rec.span("moves.materialize"):
+        # Materialize ops flat: valid entries sort to the front of each row
+        # (invalid keys are 2^30), so row pi's moves are its first
+        # counts[pi] flat entries.  One pass over the ~total-op count
+        # instead of P x L Python iterations.
+        mask = d_ops >= 0
+        counts = mask.sum(axis=1)
+        flat = mask.reshape(-1)
+        node_names = np.asarray(nodes, dtype=object)[
+            d_nodes.reshape(-1)[flat]]
+        state_arr = np.asarray(states + [""], dtype=object)
+        state_names = state_arr[d_states.reshape(-1)[flat]]  # -1 wraps to ""
+        op_arr = np.asarray(OP_NAMES, dtype=object)
+        op_names = op_arr[d_ops.reshape(-1)[flat]]
+        flat_moves = [NodeStateOp(n_, s_, o_) for n_, s_, o_ in
+                      zip(node_names.tolist(), state_names.tolist(),
+                          op_names.tolist())]
+        offsets = np.zeros(P + 1, np.int64)
+        np.cumsum(counts, out=offsets[1:])
 
-    out: dict[str, list[NodeStateOp]] = {}
-    for pi, name in enumerate(names):
-        if name in irregular:
-            out[name] = calc_partition_moves(
-                states,
-                beg_map[name].nodes_by_state,
-                end_map[name].nodes_by_state,
-                favor_min_nodes)
-        else:
-            out[name] = flat_moves[offsets[pi]:offsets[pi + 1]]
-    return out
+        out: dict[str, list[NodeStateOp]] = {}
+        for pi, name in enumerate(names):
+            if name in irregular:
+                out[name] = calc_partition_moves(
+                    states,
+                    beg_map[name].nodes_by_state,
+                    end_map[name].nodes_by_state,
+                    favor_min_nodes)
+            else:
+                out[name] = flat_moves[offsets[pi]:offsets[pi + 1]]
+        rec.count("moves.total_ops", int(counts.sum()))
+        return out

@@ -1,0 +1,59 @@
+"""blance_tpu.obs — unified tracing & metrics for the whole pipeline.
+
+One process-local :class:`Recorder` (``get_recorder()``) receives spans,
+counters, and histograms from every layer:
+
+=====================  ======================================================
+layer                  signals
+=====================  ======================================================
+plan (api/tensor)      ``plan.encode`` / ``plan.solve`` / ``plan.decode``
+                       spans (engine + fallback attributes),
+                       ``plan.solve.sweeps`` convergence counter/histogram
+plan (greedy)          ``plan.greedy`` span,
+                       ``plan.greedy.candidates`` scoring histogram
+moves (batch)          ``moves.calc_all_moves`` / ``moves.encode`` /
+                       ``moves.device_diff`` / ``moves.materialize`` spans
+orchestrate            ``orchestrate.move`` lifecycle span per fed batch,
+                       split into ``.wait`` (queue/concurrency wait) and
+                       ``.exec`` (mover callback) children;
+                       ``orchestrate.move_latency_s`` histogram; every
+                       OrchestratorProgress counter mirrored as
+                       ``orchestrate.tot_*``
+=====================  ======================================================
+
+Sinks decide retention (``sinks.InMemorySink``, ``sinks.JsonlSink``,
+``chrome.ChromeTraceSink``); the recorder alone keeps only aggregates.
+``chrome.trace(path)`` captures a region into a chrome://tracing /
+Perfetto-loadable file; ``utils.trace.PhaseTimer`` remains as a thin
+compatibility shim whose phases are recorded as spans here.
+
+See docs/OBSERVABILITY.md for the architecture tour.
+"""
+
+from .chrome import ChromeTraceSink, trace, write_chrome_trace
+from .recorder import (
+    Recorder,
+    Span,
+    get_recorder,
+    percentile,
+    phase_span,
+    set_recorder,
+    use_recorder,
+)
+from .sinks import InMemorySink, JsonlSink, span_to_dict
+
+__all__ = [
+    "Recorder",
+    "Span",
+    "get_recorder",
+    "set_recorder",
+    "use_recorder",
+    "phase_span",
+    "percentile",
+    "InMemorySink",
+    "JsonlSink",
+    "span_to_dict",
+    "ChromeTraceSink",
+    "write_chrome_trace",
+    "trace",
+]

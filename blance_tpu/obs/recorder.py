@@ -1,0 +1,314 @@
+"""Process-local tracing & metrics core: spans, counters, histograms.
+
+The Recorder is the single funnel every layer reports into (plan encode/
+solve/decode, the greedy scorer, the batched move diff, the orchestrator's
+per-move lifecycle).  Three primitives:
+
+- **Spans**: nestable timed regions with attributes.  Parent tracking uses
+  a ``contextvars.ContextVar``, so nesting is correct both synchronously
+  and across asyncio tasks (a task inherits the span that was current when
+  it was created, and sibling tasks cannot become each other's parents).
+  Spans can also be *manufactured* after the fact (``record_span``) for
+  lifecycles whose start predates the code that observes them — e.g. a
+  move request's queue-wait time, measured by the mover that dequeues it.
+- **Counters**: monotonic named floats (``count``).
+- **Histograms**: named value series (``observe``) summarized by
+  nearest-rank percentiles (p50/p95) — per-move latency, solver sweep
+  counts, greedy candidate-list sizes.
+
+The Recorder itself keeps only O(#names) aggregate state: span totals,
+counters, exact histogram stats (count/sum/min/max), and a BOUNDED
+histogram sample — once a series reaches ``_HIST_CAP`` values it is
+decimated 2:1 and subsequent observations are systematically subsampled
+(deterministic, no RNG), so percentiles stay representative while memory
+stays flat.  Finished spans are retained only by attached sinks
+(``blance_tpu.obs.sinks``); an un-sinked recorder in a long-running
+service never grows with traffic.
+
+Timestamps are ``time.perf_counter()`` seconds, offset against the
+recorder's construction time (``t0``) at export — one consistent
+monotonic clock for every span in a process, which is what lets the
+Chrome-trace exporter lay host spans on a single timeline next to
+``device_profile`` TPU traces captured over the same interval.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+__all__ = [
+    "Span",
+    "Recorder",
+    "get_recorder",
+    "set_recorder",
+    "use_recorder",
+    "phase_span",
+    "percentile",
+]
+
+
+@dataclass
+class Span:
+    """One finished (or in-flight) timed region."""
+
+    name: str
+    t_start: float  # perf_counter seconds
+    t_end: Optional[float]  # None while in flight
+    attrs: dict
+    span_id: int
+    parent_id: Optional[int]
+    task: str  # logical lane (thread/asyncio task/node) for trace viewers
+    # Backdated / manufactured spans (explicit t_start, record_span) can
+    # partially overlap live spans on their lane — e.g. a move's queue
+    # wait starts while the mover is still executing the previous batch.
+    # Exporters whose slice format requires strict nesting per lane
+    # (Chrome "X" events) must emit these as async events instead.
+    overlappable: bool = False
+
+    @property
+    def duration_s(self) -> float:
+        return (self.t_end or self.t_start) - self.t_start
+
+
+def percentile(values: list, q: float) -> float:
+    """Nearest-rank percentile of an UNSORTED value list.
+
+    rank = ceil(q/100 * n) clamped to [1, n]; q=0 returns the minimum,
+    q=100 the maximum.  Deterministic (no interpolation), so summaries
+    are stable across platforms and reproducible in tests."""
+    if not values:
+        raise ValueError("percentile of empty series")
+    s = sorted(values)
+    rank = max(1, min(len(s), math.ceil(q / 100.0 * len(s))))
+    return s[rank - 1]
+
+
+# Per-series percentile-sample bound: at the cap the sample is decimated
+# 2:1 and the subsample stride doubles, so memory stays O(_HIST_CAP) while
+# the sample stays spread evenly over the series' whole history.
+_HIST_CAP = 4096
+
+
+def _current_task_label() -> str:
+    """Lane label: the asyncio task name when inside one, else the thread."""
+    try:
+        import asyncio
+
+        task = asyncio.current_task()
+        if task is not None:
+            return task.get_name()
+    except RuntimeError:
+        pass
+    return threading.current_thread().name
+
+
+class Recorder:
+    """Span/counter/histogram recorder with pluggable sinks.
+
+    Thread-safe for aggregate updates (one lock); span parenthood is
+    context-local, never locked.  ``sinks`` receive every finished span
+    via their ``span(span)`` method."""
+
+    def __init__(self, sinks: tuple = ()) -> None:
+        self.t0 = time.perf_counter()
+        self.sinks: list = list(sinks)
+        self.span_totals: dict[str, float] = {}
+        self.span_counts: dict[str, int] = {}
+        self.counters: dict[str, float] = {}
+        self.histograms: dict[str, list[float]] = {}  # bounded sample
+        self._hist_stats: dict[str, list] = {}  # [count, sum, min, max]
+        self._hist_stride: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        # Per-instance ContextVar: two recorders never share nesting state
+        # (tests swap recorders mid-process via use_recorder).
+        self._current: contextvars.ContextVar[Optional[Span]] = \
+            contextvars.ContextVar(f"obs_span_{id(self)}", default=None)
+
+    # -- spans ---------------------------------------------------------------
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self.sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self.sinks:
+                self.sinks.remove(sink)
+
+    def current_span(self) -> Optional[Span]:
+        return self._current.get()
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, t_start: Optional[float] = None,
+             task: Optional[str] = None, **attrs) -> Iterator[Span]:
+        """Open a nested span.  ``t_start`` backdates the span (e.g. to a
+        request's enqueue time); ``task`` overrides the lane label."""
+        parent = self._current.get()
+        sp = Span(
+            name=name,
+            t_start=time.perf_counter() if t_start is None else t_start,
+            t_end=None,
+            attrs=dict(attrs),
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent is not None else None,
+            task=task if task is not None else _current_task_label(),
+            overlappable=t_start is not None,
+        )
+        token = self._current.set(sp)
+        try:
+            yield sp
+        finally:
+            self._current.reset(token)
+            sp.t_end = time.perf_counter()
+            self._finish(sp)
+
+    def record_span(self, name: str, t_start: float, t_end: float, *,
+                    task: Optional[str] = None, **attrs) -> Span:
+        """Record an already-elapsed span (both endpoints known).  Parents
+        onto the caller's current span, like a live span would."""
+        parent = self._current.get()
+        sp = Span(
+            name=name, t_start=t_start, t_end=t_end, attrs=dict(attrs),
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent is not None else None,
+            task=task if task is not None else _current_task_label(),
+            overlappable=True,
+        )
+        self._finish(sp)
+        return sp
+
+    def set_attr(self, key: str, value) -> None:
+        """Attach an attribute to the current span; no-op outside any."""
+        sp = self._current.get()
+        if sp is not None:
+            sp.attrs[key] = value
+
+    def _finish(self, sp: Span) -> None:
+        with self._lock:
+            self.span_totals[sp.name] = \
+                self.span_totals.get(sp.name, 0.0) + sp.duration_s
+            self.span_counts[sp.name] = self.span_counts.get(sp.name, 0) + 1
+            sinks = list(self.sinks)
+        for sink in sinks:
+            sink.span(sp)
+
+    # -- counters / histograms ----------------------------------------------
+
+    def count(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + value
+
+    def observe(self, name: str, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            st = self._hist_stats.get(name)
+            if st is None:
+                st = self._hist_stats[name] = [0, 0.0, v, v]
+            st[0] += 1
+            st[1] += v
+            if v < st[2]:
+                st[2] = v
+            if v > st[3]:
+                st[3] = v
+            # Bounded percentile sample: systematic 1-in-stride subsample,
+            # stride doubling on each 2:1 decimation at the cap.
+            stride = self._hist_stride.get(name, 1)
+            if (st[0] - 1) % stride == 0:
+                series = self.histograms.setdefault(name, [])
+                series.append(v)
+                if len(series) >= _HIST_CAP:
+                    del series[::2]
+                    self._hist_stride[name] = stride * 2
+
+    # -- summaries -----------------------------------------------------------
+
+    def histogram_summary(self, name: str) -> Optional[dict]:
+        with self._lock:
+            st = self._hist_stats.get(name)
+            values = list(self.histograms.get(name, ()))
+        if st is None or not values:
+            return None
+        return {
+            "count": st[0],
+            "sum": st[1],
+            "min": st[2],
+            "max": st[3],
+            "p50": percentile(values, 50),
+            "p95": percentile(values, 95),
+        }
+
+    def summary(self) -> dict:
+        """Everything aggregate, JSON-serializable: per-span-name totals,
+        counters, and histogram percentile summaries — the block bench.py
+        embeds into its artifact."""
+        with self._lock:
+            spans = {
+                name: {"total_s": self.span_totals[name],
+                       "count": self.span_counts[name]}
+                for name in sorted(self.span_totals)
+            }
+            counters = {k: self.counters[k] for k in sorted(self.counters)}
+            hist_names = sorted(self.histograms)
+        return {
+            "spans": spans,
+            "counters": counters,
+            "histograms": {
+                name: self.histogram_summary(name) for name in hist_names
+            },
+        }
+
+
+# -- process-global recorder --------------------------------------------------
+
+_global_recorder = Recorder()
+
+
+def get_recorder() -> Recorder:
+    """The process-local recorder every instrumented layer reports to."""
+    return _global_recorder
+
+
+def set_recorder(recorder: Recorder) -> Recorder:
+    """Swap the process recorder; returns the previous one."""
+    global _global_recorder
+    prev = _global_recorder
+    _global_recorder = recorder
+    return prev
+
+
+@contextlib.contextmanager
+def use_recorder(recorder: Recorder) -> Iterator[Recorder]:
+    """Temporarily install ``recorder`` as the process recorder (tests)."""
+    prev = set_recorder(recorder)
+    try:
+        yield recorder
+    finally:
+        set_recorder(prev)
+
+
+@contextlib.contextmanager
+def phase_span(name: str, timer=None, phase: Optional[str] = None,
+               **attrs) -> Iterator[Span]:
+    """Recorder span that ALSO accumulates into a PhaseTimer.
+
+    The instrumented pipeline names spans hierarchically ("plan.encode")
+    while PhaseTimer callers keep their short phase keys ("encode", the
+    default: the last dot segment) — one timed region, two views, no
+    double-recorded span."""
+    rec = get_recorder()
+    start = time.perf_counter()
+    try:
+        with rec.span(name, **attrs) as sp:
+            yield sp
+    finally:
+        if timer is not None:
+            timer._accumulate(phase or name.rsplit(".", 1)[-1],
+                              time.perf_counter() - start)

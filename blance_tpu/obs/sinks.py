@@ -1,0 +1,83 @@
+"""Span sinks: where finished spans go.
+
+A sink is any object with a ``span(span)`` method (and an optional
+``close()``).  The Recorder itself keeps only aggregates; retention is the
+sink's job, so attaching no sink costs no memory growth.
+
+- ``InMemorySink``: keeps Span objects — the test/debug sink.
+- ``JsonlSink``: one JSON object per finished span, streamed to a file —
+  the production log-shipping shape (grep-able, tail-able, no buffering
+  of the whole trace in memory).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import IO, Optional, Union
+
+from .recorder import Span
+
+__all__ = ["InMemorySink", "JsonlSink", "span_to_dict"]
+
+
+def span_to_dict(sp: Span, t0: float = 0.0) -> dict:
+    """JSON-serializable view of a span; times shifted by ``t0`` so
+    exported timestamps start near zero."""
+    return {
+        "name": sp.name,
+        "t_start_s": sp.t_start - t0,
+        "duration_s": sp.duration_s,
+        "span_id": sp.span_id,
+        "parent_id": sp.parent_id,
+        "task": sp.task,
+        "attrs": sp.attrs,
+    }
+
+
+class InMemorySink:
+    """Retains every finished span (tests, small traces)."""
+
+    def __init__(self) -> None:
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def span(self, sp: Span) -> None:
+        with self._lock:
+            self.spans.append(sp)
+
+    def by_name(self, name: str) -> list[Span]:
+        with self._lock:
+            return [sp for sp in self.spans if sp.name == name]
+
+    def close(self) -> None:  # symmetry with file-backed sinks
+        pass
+
+
+class JsonlSink:
+    """Streams spans as JSON lines to ``path`` (or an open file object).
+
+    Lines are written and flushed per span under a lock, so concurrent
+    asyncio tasks / threads interleave whole records, never bytes."""
+
+    def __init__(self, path_or_file: Union[str, IO], t0: float = 0.0) -> None:
+        self._own = isinstance(path_or_file, str)
+        self._f: Optional[IO] = (
+            open(path_or_file, "w") if self._own else path_or_file)
+        self._t0 = t0
+        self._lock = threading.Lock()
+
+    def span(self, sp: Span) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            json.dump(span_to_dict(sp, self._t0), self._f,
+                      default=str, separators=(",", ":"))
+            self._f.write("\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and self._own:
+                self._f.close()
+            self._f = None

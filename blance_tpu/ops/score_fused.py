@@ -61,6 +61,12 @@ def _tile_env(name: str, default: int, multiple: int) -> int:
 _TILE_P = _tile_env("BLANCE_FUSED_TILE_P", 256, 8)
 _TILE_N = _tile_env("BLANCE_FUSED_TILE_N", 2048, 128)
 
+try:  # ``vma`` on ShapeDtypeStruct arrived with JAX's varying-axes model
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:
+    _SDS_HAS_VMA = False
+
 __all__ = ["fused_score_min2", "ScoreInputs", "pack_score_inputs",
            "score_at_columns", "jitter_hash"]
 
@@ -296,7 +302,10 @@ def fused_score_min2(
     t_width = si.taken.shape[1]
     a_width = si.present.shape[1]
 
-    sds_kw = {"vma": frozenset(vma)} if vma else {}
+    # Pre-vma JAX has no varying-axes checker (and no ``vma`` kwarg on
+    # ShapeDtypeStruct); those runtimes use check_rep=False instead, so
+    # the annotation is simply not needed there.
+    sds_kw = {"vma": frozenset(vma)} if vma and _SDS_HAS_VMA else {}
     out_shape = [
         jax.ShapeDtypeStruct((p, 1), jnp.float32, **sds_kw),  # best
         jax.ShapeDtypeStruct((p, 1), jnp.int32, **sds_kw),    # idx (local)

@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional, Union
 
 from ..core.types import PartitionMap, PartitionModel
 from ..moves.calc import calc_partition_moves
+from ..obs import get_recorder
 from ..plan.greedy import sort_state_names
 from .csp import Chan, select, GET, PUT
 
@@ -161,13 +163,18 @@ class NextMoves:
 
 
 class _PartitionMoveReq:
-    """A batch of moves for one node + completion channel (orchestrate.go:220-223)."""
+    """A batch of moves for one node + completion channel (orchestrate.go:220-223).
 
-    __slots__ = ("partition_moves", "done_ch")
+    ``t_created`` stamps the feeder's creation time so the mover that
+    eventually dequeues the batch can attribute queue/concurrency wait
+    separately from callback execution (the ``orchestrate.move`` span)."""
+
+    __slots__ = ("partition_moves", "done_ch", "t_created")
 
     def __init__(self, partition_moves: list[PartitionMove], done_ch: Chan) -> None:
         self.partition_moves = partition_moves
         self.done_ch = done_ch
+        self.t_created = time.perf_counter()
 
 
 AssignPartitionsFunc = Callable[..., Union[Optional[Exception], Awaitable]]
@@ -205,6 +212,12 @@ class Orchestrator:
         self._map_partition_to_next_moves = map_partition_to_next_moves
 
         self._tasks: list[asyncio.Task] = []
+        # Every progress counter is mirrored into the obs Recorder
+        # (orchestrate.tot_*) as it increments, so one sink sees the
+        # progress stream, the planner spans, and the move lifecycle
+        # together.  Bound once: a rebalance reports to the recorder that
+        # was installed when it started.
+        self._rec = get_recorder()
 
     # -- public control surface ---------------------------------------------
 
@@ -218,7 +231,7 @@ class Orchestrator:
         """Idempotent async stop; the progress channel eventually closes
         (orchestrate.go:342-350)."""
         if self._stop_ch is not None:
-            self._progress.tot_stop += 1
+            self._bump_sync("tot_stop")
             self._stop_ch.close()
             self._stop_ch = None
 
@@ -227,12 +240,12 @@ class Orchestrator:
         (orchestrate.go:367-375)."""
         if self._pause_ch is None:
             self._pause_ch = Chan()
-            self._progress.tot_pause_new_assignments += 1
+            self._bump_sync("tot_pause_new_assignments")
 
     def resume_new_assignments(self) -> None:
         """Idempotent resume (orchestrate.go:379-388)."""
         if self._pause_ch is not None:
-            self._progress.tot_resume_new_assignments += 1
+            self._bump_sync("tot_resume_new_assignments")
             self._pause_ch.close()
             self._pause_ch = None
 
@@ -263,6 +276,17 @@ class Orchestrator:
         mutate()
         await self._progress_ch.put(self._progress.snapshot())
 
+    def _bump_sync(self, *names: str) -> None:
+        """Increment progress counters, mirrored into the Recorder."""
+        for name in names:
+            setattr(self._progress, name, getattr(self._progress, name) + 1)
+            self._rec.count("orchestrate." + name)
+
+    async def _bump(self, *names: str) -> None:
+        """_bump_sync + blocking progress snapshot — the one spelling every
+        counter-only progress event goes through."""
+        await self._update_progress(lambda: self._bump_sync(*names))
+
     async def _call_assign(self, stop_ch, node, partitions, states, ops):
         """Invoke the app callback (sync or async); exceptions become the
         move's error."""
@@ -275,19 +299,25 @@ class Orchestrator:
         return result if isinstance(result, Exception) else None
 
     async def _run_mover(self, stop_ch: Chan, done_ch: Chan, node: str) -> None:
-        await self._update_progress(
-            lambda: setattr(self._progress, "tot_run_mover",
-                            self._progress.tot_run_mover + 1))
+        await self._bump("tot_run_mover")
         err = await self._mover_loop(stop_ch, self._map_node_to_req_ch[node], node)
         await done_ch.put(err)
 
     async def _mover_loop(self, stop_ch: Chan, req_ch: Chan, node: str):
         """Receive batched move requests and run the assign callback
-        synchronously per batch (orchestrate.go:426-480)."""
+        synchronously per batch (orchestrate.go:426-480).
+
+        Each dequeued batch becomes one ``orchestrate.move`` lifecycle span
+        on the ``mover:<node>`` lane, starting at the feeder's request
+        creation: an ``orchestrate.move.wait`` child (time spent queued
+        behind this node's concurrency limit / rendezvous) and an
+        ``orchestrate.move.exec`` child (the app callback), so per-node
+        wait is attributable separately from mover execution.  Callback
+        latency also lands in the ``orchestrate.move_latency_s`` histogram,
+        once per partition move in the batch with the batch's exec time
+        amortized across them (histogram sum = exec wall-clock)."""
         while True:
-            await self._update_progress(
-                lambda: setattr(self._progress, "tot_mover_loop",
-                                self._progress.tot_mover_loop + 1))
+            await self._bump("tot_mover_loop")
 
             which, value = await select((GET, stop_ch), (GET, req_ch))
             if which == 0:
@@ -295,23 +325,43 @@ class Orchestrator:
             req, ok = value
             if not ok:
                 return None
+            t_recv = time.perf_counter()
 
             partitions = [pm.partition for pm in req.partition_moves]
             states = [pm.state for pm in req.partition_moves]
             ops = [pm.op for pm in req.partition_moves]
 
-            await self._update_progress(
-                lambda: setattr(self._progress, "tot_mover_assign_partition",
-                                self._progress.tot_mover_assign_partition + 1))
+            lane = f"mover:{node}"
+            with self._rec.span(
+                    "orchestrate.move", t_start=req.t_created, task=lane,
+                    node=node, moves=len(req.partition_moves)) as mv:
+                self._rec.record_span(
+                    "orchestrate.move.wait", req.t_created, t_recv,
+                    task=lane, node=node)
 
-            err = await self._call_assign(stop_ch, node, partitions, states, ops)
+                await self._bump("tot_mover_assign_partition")
 
-            def count():
-                if err is not None:
-                    self._progress.tot_mover_assign_partition_err += 1
-                else:
-                    self._progress.tot_mover_assign_partition_ok += 1
-            await self._update_progress(count)
+                t_exec = time.perf_counter()
+                with self._rec.span("orchestrate.move.exec", task=lane,
+                                    node=node, ops=",".join(ops)):
+                    err = await self._call_assign(
+                        stop_ch, node, partitions, states, ops)
+                exec_s = time.perf_counter() - t_exec
+                mv.attrs["wait_s"] = t_recv - req.t_created
+                mv.attrs["exec_s"] = exec_s
+                mv.attrs["ok"] = err is None
+                # One observation per partition move, with the batch's
+                # callback time amortized across its moves — so the
+                # histogram's sum equals real exec wall-clock, not
+                # batch-size-weighted batch latency.
+                per_move_s = exec_s / max(len(req.partition_moves), 1)
+                for _ in req.partition_moves:
+                    self._rec.observe("orchestrate.move_latency_s",
+                                      per_move_s)
+
+                await self._bump(
+                    "tot_mover_assign_partition_err" if err is not None
+                    else "tot_mover_assign_partition_ok")
 
             if req.done_ch is not None:
                 if err is not None:
@@ -373,9 +423,7 @@ class Orchestrator:
         err_outer = None
 
         while err_outer is None:
-            await self._update_progress(
-                lambda: setattr(self._progress, "tot_run_supply_moves_loop",
-                                self._progress.tot_run_supply_moves_loop + 1))
+            await self._bump("tot_run_supply_moves_loop")
 
             available = self._find_available_moves()
             pause_ch = self._pause_ch
@@ -386,13 +434,9 @@ class Orchestrator:
             # Pause blocks the whole supplier between rounds; Stop() while
             # paused requires a resume first (orchestrate.go:531-544).
             if pause_ch is not None:
-                await self._update_progress(
-                    lambda: setattr(self._progress, "tot_run_supply_moves_pause",
-                                    self._progress.tot_run_supply_moves_pause + 1))
+                await self._bump("tot_run_supply_moves_pause")
                 await pause_ch.get()
-                await self._update_progress(
-                    lambda: setattr(self._progress, "tot_run_supply_moves_resume",
-                                    self._progress.tot_run_supply_moves_resume + 1))
+                await self._bump("tot_run_supply_moves_resume")
 
             broadcast_stop_ch = Chan()
             broadcast_done_ch = Chan()
@@ -420,9 +464,7 @@ class Orchestrator:
                 self._tasks.append(asyncio.ensure_future(self._run_supply_move(
                     stop_ch, node, picked, broadcast_stop_ch, broadcast_done_ch)))
 
-            await self._update_progress(
-                lambda: setattr(self._progress, "tot_run_supply_moves_feeding",
-                                self._progress.tot_run_supply_moves_feeding + 1))
+            await self._bump("tot_run_supply_moves_feeding")
 
             # First successful feed interrupts the other feeders so the next
             # round recomputes availability (orchestrate.go:566-580); in
@@ -437,33 +479,28 @@ class Orchestrator:
                 if err is not None and err is not ErrorInterrupt and err_outer is None:
                     err_outer = err
 
-            await self._update_progress(
-                lambda: setattr(self._progress, "tot_run_supply_moves_feeding_done",
-                                self._progress.tot_run_supply_moves_feeding_done + 1))
+            await self._bump("tot_run_supply_moves_feeding_done")
 
             if not broadcast_stopped:
                 broadcast_stop_ch.close()
             broadcast_done_ch.close()
 
-        await self._update_progress(
-            lambda: setattr(self._progress, "tot_run_supply_moves_loop_done",
-                            self._progress.tot_run_supply_moves_loop_done + 1))
+        await self._bump("tot_run_supply_moves_loop_done")
 
         for req_ch in self._map_node_to_req_ch.values():
             req_ch.close()
 
         def count_done():
-            self._progress.tot_run_supply_moves_done += 1
+            self._bump_sync("tot_run_supply_moves_done")
             if err_outer is not None and err_outer is not ErrorStopped:
                 self._progress.errors.append(err_outer)
-                self._progress.tot_run_supply_moves_done_err += 1
+                self._bump_sync("tot_run_supply_moves_done_err")
+                self._rec.count("orchestrate.errors")
         await self._update_progress(count_done)
 
         await self._wait_for_all_movers_done(run_mover_done_ch)
 
-        await self._update_progress(
-            lambda: setattr(self._progress, "tot_progress_close",
-                            self._progress.tot_progress_close + 1))
+        await self._bump("tot_progress_close")
 
         self._progress_ch.close()
 
@@ -545,10 +582,11 @@ class Orchestrator:
             err, _ok = await run_mover_done_ch.get()
 
             def count():
-                self._progress.tot_run_mover_done += 1
+                self._bump_sync("tot_run_mover_done")
                 if err is not None:
                     self._progress.errors.append(err)
-                    self._progress.tot_run_mover_done_err += 1
+                    self._bump_sync("tot_run_mover_done_err")
+                    self._rec.count("orchestrate.errors")
             await self._update_progress(count)
 
 
@@ -584,25 +622,28 @@ def orchestrate_moves(
     # Per-partition flight plans, computed up front without regard to other
     # partitions (orchestrate.go:264-287) — on device when asked.
     map_partition_to_next_moves: dict[str, NextMoves] = {}
-    if options.device_diff:
-        from ..moves.batch import calc_all_moves
+    with get_recorder().span(
+            "orchestrate.plan_moves", partitions=len(beg_map),
+            device_diff=options.device_diff):
+        if options.device_diff:
+            from ..moves.batch import calc_all_moves
 
-        all_moves = calc_all_moves(
-            beg_map, end_map, model, options.favor_min_nodes)
-        for partition_name in beg_map:
-            map_partition_to_next_moves[partition_name] = NextMoves(
-                partition_name, all_moves[partition_name])
-    else:
-        for partition_name, beg_partition in beg_map.items():
-            end_partition = end_map[partition_name]
-            moves = calc_partition_moves(
-                states,
-                beg_partition.nodes_by_state,
-                end_partition.nodes_by_state,
-                options.favor_min_nodes,
-            )
-            map_partition_to_next_moves[partition_name] = NextMoves(
-                partition_name, moves)
+            all_moves = calc_all_moves(
+                beg_map, end_map, model, options.favor_min_nodes)
+            for partition_name in beg_map:
+                map_partition_to_next_moves[partition_name] = NextMoves(
+                    partition_name, all_moves[partition_name])
+        else:
+            for partition_name, beg_partition in beg_map.items():
+                end_partition = end_map[partition_name]
+                moves = calc_partition_moves(
+                    states,
+                    beg_partition.nodes_by_state,
+                    end_partition.nodes_by_state,
+                    options.favor_min_nodes,
+                )
+                map_partition_to_next_moves[partition_name] = NextMoves(
+                    partition_name, moves)
 
     o = Orchestrator(
         model, options, nodes_all, beg_map, end_map,

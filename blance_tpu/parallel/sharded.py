@@ -35,6 +35,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.encode import DenseProblem
 from ..plan.tensor import solve_dense_converged
 
+# shard_map moved across JAX versions (jax.experimental.shard_map ->
+# top-level jax.shard_map); resolve once so the pinned CI versions and
+# newer runtimes both work.
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # older jax (e.g. 0.4.x)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 __all__ = ["make_mesh", "make_mesh_2d", "make_hybrid_mesh",
            "slice_major_order", "solve_dense_sharded",
            "pad_partitions", "pad_nodes"]
@@ -222,10 +230,14 @@ def solve_dense_sharded(
         node_shards=node_shards,
         fused_score=fused_score,
     )
-    sm = partial(jax.shard_map, body, mesh=mesh,
+    sm = partial(_shard_map, body, mesh=mesh,
                  in_specs=(shard, shard, rep, rep, shard, rep, rep),
                  out_specs=shard)
-    if not node_axis and fused_score == "off":
+    # Pre-vma JAX (the check_rep model: no lax.pcast/pvary) has no
+    # replication rule for while_loop, so the checker must be off on ANY
+    # mesh there; vma-era JAX keeps it on for the plain 1-D matrix path.
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    if has_vma and not node_axis and fused_score == "off":
         fn = sm()
     else:
         # The output is node-replicated by construction — every node shard

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from ..obs import get_recorder
 from .greedy import plan_next_map_greedy
 
 __all__ = ["plan_next_map", "plan_next_map_legacy"]
@@ -48,27 +49,31 @@ def plan_next_map(
         raise ValueError("model is required")
     opts = opts or PlanOptions()
 
+    requested = backend
     if backend == "auto":
         size = len(partitions_to_assign) * len(nodes_all)
         backend = "tpu" if size >= _AUTO_TPU_THRESHOLD else "native"
 
-    if backend == "greedy":
-        return plan_next_map_greedy(
-            prev_map, partitions_to_assign, nodes_all,
-            nodes_to_remove, nodes_to_add, model, opts)
-    if backend == "native":
-        from .native import plan_next_map_native  # deferred: may compile
+    with get_recorder().span(
+            "plan.plan_next_map", backend=backend, requested=requested,
+            partitions=len(partitions_to_assign), nodes=len(nodes_all)):
+        if backend == "greedy":
+            return plan_next_map_greedy(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, nodes_to_add, model, opts)
+        if backend == "native":
+            from .native import plan_next_map_native  # deferred: may compile
 
-        return plan_next_map_native(
-            prev_map, partitions_to_assign, nodes_all,
-            nodes_to_remove, nodes_to_add, model, opts)
-    if backend == "tpu":
-        from .tensor import plan_next_map_tpu  # deferred: imports jax
+            return plan_next_map_native(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, nodes_to_add, model, opts)
+        if backend == "tpu":
+            from .tensor import plan_next_map_tpu  # deferred: imports jax
 
-        return plan_next_map_tpu(
-            prev_map, partitions_to_assign, nodes_all,
-            nodes_to_remove, nodes_to_add, model, opts, timer=timer)
-    raise ValueError(f"unknown backend: {backend!r}")
+            return plan_next_map_tpu(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, nodes_to_add, model, opts, timer=timer)
+        raise ValueError(f"unknown backend: {backend!r}")
 
 
 def plan_next_map_legacy(

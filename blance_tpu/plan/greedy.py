@@ -35,6 +35,7 @@ from ..core.hierarchy import (
     parents_to_children,
 )
 from ..core.setops import strings_dedup, strings_intersect, strings_remove
+from ..obs import get_recorder
 from ..core.types import (
     Partition,
     PartitionMap,
@@ -390,13 +391,33 @@ def _find_best_nodes(
         # Full-sorter replacement (reference CustomNodeSorter,
         # plan.go:566-580): the hook owns score AND tie-break policy.
         def sort_candidates(nodes):
-            return list(opts.node_sorter(score_ctx, nodes))
+            out = list(opts.node_sorter(score_ctx, nodes))
+            if sorted(out) != sorted(nodes):
+                # A hook that drops/duplicates/invents nodes would silently
+                # corrupt placement (missing candidates look like unmet
+                # constraints, invented ones place onto ghost nodes) —
+                # reject it loudly at the boundary instead.
+                from collections import Counter
+
+                want, got = Counter(nodes), Counter(out)
+                missing = sorted((want - got).elements())[:3]
+                extra = sorted((got - want).elements())[:3]
+                raise ValueError(
+                    "node_sorter must return a permutation of its input "
+                    f"nodes: got {len(out)} nodes from {len(nodes)}"
+                    f"{', missing ' + repr(missing) if missing else ''}"
+                    f"{', unexpected/duplicated ' + repr(extra) if extra else ''}"
+                    f" (partition {partition.name!r}, state {state_name!r})")
+            return out
     else:
         scorer = opts.node_scorer or default_node_score
 
         def sort_candidates(nodes):
             return _sort_nodes(score_ctx, nodes, scorer)
     candidates = sort_candidates(candidates)
+    # Scoring-cost attribution: how many candidates each (partition, state)
+    # pick had to score — the distribution that explains greedy wall-clock.
+    get_recorder().observe("plan.greedy.candidates", len(candidates))
 
     if opts.hierarchy_rules is not None:
         # Hierarchy pass (plan.go:174-226): each rule contributes up to
@@ -544,6 +565,23 @@ def plan_next_map_greedy(
     """
     opts = opts or PlanOptions()
 
+    with get_recorder().span(
+            "plan.greedy", partitions=len(partitions_to_assign),
+            nodes=len(nodes_all)):
+        return _plan_next_map_greedy(
+            prev_map, partitions_to_assign, nodes_all, nodes_to_remove,
+            nodes_to_add, model, opts)
+
+
+def _plan_next_map_greedy(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    nodes_to_add: Optional[list[str]],
+    model: PartitionModel,
+    opts: PlanOptions,
+) -> tuple[PartitionMap, dict[str, list[str]]]:
     prev_map = copy_partition_map(prev_map)
     partitions_to_assign = copy_partition_map(partitions_to_assign)
     nodes_all = list(nodes_all)

@@ -46,6 +46,7 @@ from jax import lax
 
 from ..core.encode import DenseProblem, decode_assignment, encode_problem
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from ..obs import get_recorder, phase_span
 from ..ops.reduce2 import (
     min2_argmin_reference,
     pallas_available,
@@ -429,6 +430,14 @@ def _combine_min2(
     return take(bests), take(choices), second, take(raws)
 
 
+def _axis_size(axis_name: str):
+    """``lax.axis_size`` appeared in newer JAX; ``psum(1, axis)`` is the
+    long-standing equivalent on older pins (e.g. 0.4.x)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _shard_capacity(cap: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     """Split global per-node capacity into integral per-shard shares.
 
@@ -438,7 +447,7 @@ def _shard_capacity(cap: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     """
     if not axis_name:
         return cap
-    ns = lax.axis_size(axis_name)
+    ns = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     base_cap = jnp.floor(cap / ns)
     rem = cap - base_cap * ns
@@ -555,7 +564,7 @@ def _pin_prev_holders(
         # node's weight units — whichever is larger.  The lmin band is
         # divided by the shard count like the quota: it is a GLOBAL
         # allowance, and each shard orders only its own holders.
-        ns = lax.axis_size(axis_name) if axis_name else 1
+        ns = _axis_size(axis_name) if axis_name else 1
         nclip = jnp.clip(node_s, 0, n - 1)
         band = (lmin + slack[perm]) * div[nclip] / ns
         cap_here = jnp.maximum(cap_quota[nclip], band)
@@ -771,14 +780,19 @@ def _assign_slot(
             continue
         # Freshly-created carries are axis-invariant until the (shard-local)
         # loop body makes them varying; mark them varying up front so carry
-        # types agree.  Skip values that are already varying.
-        _to_varying = (
-            (lambda x: lax.pcast(x, (ax,), to="varying"))
-            if hasattr(lax, "pcast")
-            else (lambda x: lax.pvary(x, (ax,))))
+        # types agree.  Skip values that are already varying.  Pre-vma JAX
+        # (the check_rep model: no pcast/pvary) has no varying-axes types
+        # to reconcile, so there is nothing to mark.
+        if hasattr(lax, "pcast"):
+            _to_varying = lambda x: lax.pcast(x, (ax,), to="varying")
+        elif hasattr(lax, "pvary"):
+            _to_varying = lambda x: lax.pvary(x, (ax,))
+        else:
+            continue
+        _typeof = jax.typeof if hasattr(jax, "typeof") else jax.core.get_aval
 
         def ensure_varying(x):
-            vma = getattr(jax.typeof(x), "vma", frozenset())
+            vma = getattr(_typeof(x), "vma", frozenset())
             return x if ax in vma else _to_varying(x)
         init = tuple(ensure_varying(x) for x in init)
     slot_assign, unassigned, _rem, used, _, _ = lax.while_loop(
@@ -1225,7 +1239,7 @@ def solve_dense(
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
                                    "max_iterations", "node_axis",
                                    "node_shards", "fused_score"))
-def solve_dense_converged(
+def _solve_dense_converged_impl(
     prev: jnp.ndarray,
     pweights: jnp.ndarray,
     nweights: jnp.ndarray,
@@ -1240,18 +1254,8 @@ def solve_dense_converged(
     node_axis: Optional[str] = None,
     node_shards: int = 1,
     fused_score: str = "off",
-) -> jnp.ndarray:
-    """solve_dense iterated to a fixpoint (reference plan.go:23-58).
-
-    The reference replans on its own output until stable (≤ 10 passes,
-    "usually 1 or 2"): the first pass does the work, later passes converge
-    because the warm-start pins hold everything the capacity rail accepts.
-    A converged pass short-circuits the auction (every copy pins), so the
-    confirming iteration costs a fraction of the first.  Like the
-    reference, cluster deltas apply only to the first pass — subsequent
-    passes re-balance on the stable node set (plan.go:49-55; removed nodes
-    hold nothing after pass 1, so a constant valid mask is equivalent).
-    """
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Jitted fixpoint body; returns (assign, sweeps-executed)."""
     def solve(x):
         return solve_dense(x, pweights, nweights, valid, stickiness,
                            gids, gid_valid, constraints, rules, axis_name,
@@ -1270,7 +1274,70 @@ def solve_dense_converged(
         out, _prev, it = carry
         return solve(out), out, it + 1
 
-    out, _, _ = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
+    out, _, it = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
+    return out, it
+
+
+def _record_sweeps(sweeps) -> None:
+    """Publish a converged solve's pass count to the obs Recorder.
+
+    Silently skipped when ``sweeps`` is a tracer (solve_dense_converged
+    runs under shard_map / an outer jit: there is no concrete value at
+    trace time, and a host callback would be the wrong cost to pay)."""
+    if isinstance(sweeps, jax.core.Tracer):
+        return
+    try:
+        n = int(sweeps)
+    except Exception:
+        return
+    rec = get_recorder()
+    rec.count("plan.solve.calls")
+    rec.count("plan.solve.sweeps", n)
+    rec.observe("plan.solve.sweeps", n)
+    rec.set_attr("sweeps", n)
+
+
+def solve_dense_converged(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    max_iterations: int = 10,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
+    fused_score: str = "off",
+    record: bool = True,
+) -> jnp.ndarray:
+    """solve_dense iterated to a fixpoint (reference plan.go:23-58).
+
+    The reference replans on its own output until stable (≤ 10 passes,
+    "usually 1 or 2"): the first pass does the work, later passes converge
+    because the warm-start pins hold everything the capacity rail accepts.
+    A converged pass short-circuits the auction (every copy pins), so the
+    confirming iteration costs a fraction of the first.  Like the
+    reference, cluster deltas apply only to the first pass — subsequent
+    passes re-balance on the stable node set (plan.go:49-55; removed nodes
+    hold nothing after pass 1, so a constant valid mask is equivalent).
+
+    The executed pass count surfaces as the ``plan.solve.sweeps``
+    counter/histogram on the obs Recorder (the loop itself is fused into
+    one device program, so per-sweep host spans cannot exist).  Reading it
+    costs one scalar device-to-host sync; ``record=False`` skips that —
+    for micro-timed loops where an extra host round-trip would perturb
+    the measurement (under jit/shard_map tracing it is skipped anyway).
+    """
+    out, sweeps = _solve_dense_converged_impl(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        constraints, rules, axis_name, max_iterations, node_axis,
+        node_shards, fused_score)
+    if record:
+        _record_sweeps(sweeps)
     return out
 
 
@@ -1294,13 +1361,16 @@ def solve_converged_resilient(
     """
     import warnings as _warnings
 
+    rec = get_recorder()
+
     def run(m: str) -> np.ndarray:
         # np.asarray inside the guarded region: async dispatch can defer
         # a runtime failure to the first host read.
-        return np.asarray(solve_dense_converged(
-            prev, pweights, nweights, valid, stickiness, gids, gid_valid,
-            constraints, rules, max_iterations=max_iterations,
-            fused_score=m))
+        with rec.span("plan.solve.attempt", engine=m):
+            return np.asarray(solve_dense_converged(
+                prev, pweights, nweights, valid, stickiness, gids,
+                gid_valid, constraints, rules,
+                max_iterations=max_iterations, fused_score=m))
 
     try:
         out = run(mode)
@@ -1320,13 +1390,22 @@ def solve_converged_resilient(
             f"blance_tpu {context}: score engine {mode!r} failed to "
             f"compile/run ({type(e).__name__}: {first}); retrying with "
             f"{alt!r}", UserWarning, stacklevel=3)
+        rec.count("plan.engine_fallback")
         out = run(alt)
         mode = alt
+        # timer.annotate forwards to rec.set_attr (PhaseTimer is a shim
+        # over the Recorder), so write directly only when there is no
+        # timer — never both.
         if timer is not None:
             timer.annotate("engine_fallback", f"-> {alt}")
+        else:
+            rec.set_attr("engine_fallback", f"-> {alt}")
+    engine = {"off": "matrix", "on": "fused",
+              "interpret": "fused-interpret"}[mode]
     if timer is not None:
-        timer.annotate("engine", {"off": "matrix", "on": "fused",
-                                  "interpret": "fused-interpret"}[mode])
+        timer.annotate("engine", engine)
+    else:
+        rec.set_attr("engine", engine)
     return out, mode
 
 
@@ -1738,13 +1817,14 @@ def plan_next_map_tpu(
 
         # The exact path has no encode/solve/decode split; attribute it
         # all to "solve" so a caller's timer still sees the wall-clock.
-        with timer.phase("solve"):
+        with phase_span("plan.solve", timer=timer,
+                        engine="exact-fallback"):
             return plan_next_map_native(
                 prev_map, partitions_to_assign, nodes_all,
                 nodes_to_remove, nodes_to_add, model, opts)
     del nodes_to_add
 
-    with timer.phase("encode"):
+    with phase_span("plan.encode", timer=timer):
         problem = encode_problem(
             prev_map, partitions_to_assign, nodes_all, nodes_to_remove,
             model, opts)
@@ -1758,7 +1838,8 @@ def plan_next_map_tpu(
         tuple(problem.rules.get(si, ())) for si in range(problem.S))
     constraints = tuple(int(c) for c in problem.constraints)
 
-    with timer.phase("solve"):
+    with phase_span("plan.solve", timer=timer,
+                    partitions=problem.P, nodes=problem.N):
         assign, _engine = solve_converged_resilient(
             jnp.asarray(problem.prev),
             jnp.asarray(problem.partition_weights),
@@ -1777,6 +1858,6 @@ def plan_next_map_tpu(
         )
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")
-    with timer.phase("decode"):
+    with phase_span("plan.decode", timer=timer):
         return decode_assignment(
             problem, assign, partitions_to_assign, nodes_to_remove)

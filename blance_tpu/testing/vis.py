@@ -160,6 +160,9 @@ def assert_contract(
     nidx = {n: j for j, n in enumerate(problem.nodes)}
     sidx = {s: j for j, s in enumerate(problem.states)}
     for pi, pname in enumerate(problem.partitions):
+        assert pname in result, (
+            f"{label}: planner result is missing partition {pname!r} "
+            f"(has {len(result)} of {len(problem.partitions)})")
         for s, ns in result[pname].nodes_by_state.items():
             if s not in sidx:
                 continue  # unmodeled passthrough states aren't audited
@@ -191,7 +194,7 @@ def run_vis_cases(cases: list[VisCase], backend: Optional[str] = None) -> None:
 
     ``backend`` overrides every case's backend.  The exact planners
     (greedy / native) assert the golden map bit-for-bit; the batched
-    "tpu" backend asserts CONTRACT properties instead (_assert_contract)
+    "tpu" backend asserts CONTRACT properties instead (assert_contract)
     plus the same warnings-count equality — the reference's curated hard
     cases (plan_test.go:1746-2863) pointed at the solver that is not
     meant to be bit-identical."""

@@ -4,8 +4,11 @@ The reference has no tracing (SURVEY.md §5); its observability surface is
 the orchestrator progress stream.  Here, in addition to that stream, the
 framework exposes:
 
-- ``PhaseTimer``: wall-clock phase timing with a queryable report — used by
-  the planning facade to attribute time to encode / solve / decode.
+- ``PhaseTimer``: wall-clock phase timing with a queryable report — kept
+  as a thin compatibility shim over ``blance_tpu.obs``: every phase is
+  also recorded as a Recorder span (and annotations land on the current
+  span), so legacy PhaseTimer callers feed the unified trace for free
+  while ``report()`` output stays byte-identical to the pre-obs shape.
 - ``device_profile``: context manager around jax.profiler.trace for real
   TPU traces (viewable in TensorBoard / Perfetto), no-op if profiling is
   unavailable.
@@ -17,6 +20,8 @@ import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
+
+from ..obs import get_recorder
 
 __all__ = ["PhaseTimer", "device_profile"]
 
@@ -37,14 +42,21 @@ class PhaseTimer:
     def phase(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
         try:
-            yield
+            with get_recorder().span(name):
+                yield
         finally:
-            elapsed = time.perf_counter() - start
-            self.totals[name] = self.totals.get(name, 0.0) + elapsed
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self._accumulate(name, time.perf_counter() - start)
+
+    def _accumulate(self, name: str, elapsed: float) -> None:
+        """Fold one elapsed interval into the report totals — the piece of
+        the old phase() that is NOT the span; obs.phase_span uses it to
+        time a region once while publishing both views."""
+        self.totals[name] = self.totals.get(name, 0.0) + elapsed
+        self.counts[name] = self.counts.get(name, 0) + 1
 
     def annotate(self, key: str, value: str) -> None:
         self.annotations[key] = value
+        get_recorder().set_attr(key, value)
 
     def report(self) -> dict[str, dict]:
         out: dict = {

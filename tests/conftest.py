@@ -63,7 +63,7 @@ def planner_backends():
     """Parametrize golden suites over every planner backend: the Python
     greedy oracle and the native C++ core run the goldens bit-for-bit
     (native.py's stated contract); the batched "tpu" backend runs the
-    same corpus in CONTRACT mode (testing/vis.py _assert_contract: zero
+    same corpus in CONTRACT mode (testing/vis.py assert_contract: zero
     audit violations, weighted balance within the golden oracle + 1,
     warnings-count equality) — it solves globally and is deliberately
     not bit-identical."""
