@@ -351,6 +351,74 @@ def bench_pipeline(P=256, N=32):
             "progress_events": events}
 
 
+def bench_delta_replan(P, N):
+    """Cold vs warm delta replan through PlannerSession: the
+    incremental-replanning headline (ISSUE 2).
+
+    Protocol: one session solves and applies a map (building the warm
+    carry), then removes one node and replans WARM; a second session
+    loads the identical pre-delta map (which invalidates any carry),
+    applies the same delta and replans COLD.  Reports sweeps (from the
+    obs plan.solve.sweeps counter), wall-clock for both paths, and
+    whether the maps are bit-identical — the warm path's contract."""
+    from blance_tpu import model
+    from blance_tpu.obs import get_recorder
+    from blance_tpu.plan.session import PlannerSession
+
+    nodes = [f"n{i:05d}" for i in range(N)]
+    parts = [str(i) for i in range(P)]
+    m = model(primary=(0, 1), replica=(1, 1))
+    opts = _rack_opts(nodes)
+    rec = get_recorder()
+
+    def sweeps():
+        return rec.counters.get("plan.solve.sweeps", 0)
+
+    s = PlannerSession(m, nodes, parts, opts=opts)
+    s.replan()
+    s.apply()  # promotes the carry: the next replan is warm
+    # Warm-up delta cycle: compiles the warm-repair program (the cold
+    # program compiled during the first replan), so the timed cycle
+    # below measures steady-state wall-clock on both paths.
+    s.remove_nodes([nodes[0]])
+    s.replan()
+    s.apply()
+    pre_map, _ = s.to_map()
+    victim = nodes[N // 3]
+
+    s.remove_nodes([victim])
+    c0 = sweeps()
+    h0 = rec.counters.get("plan.solve.carry_hit", 0)
+    t0 = time.perf_counter()
+    warm = s.replan().copy()
+    warm_ms = (time.perf_counter() - t0) * 1000
+    warm_sweeps = sweeps() - c0
+    # Delta, not cumulative: the warm-up cycle above already scored a
+    # hit, and this field must report the TIMED replan's outcome.
+    warm_hit = rec.counters.get("plan.solve.carry_hit", 0) - h0 > 0
+
+    s2 = PlannerSession(m, nodes, parts, opts=opts)
+    s2.load_map(pre_map)  # same state, no carry
+    s2.remove_nodes(sorted(s.removed_nodes))  # same node set incl. victim
+    c1 = sweeps()
+    t0 = time.perf_counter()
+    cold = s2.replan()
+    cold_ms = (time.perf_counter() - t0) * 1000
+    cold_sweeps = sweeps() - c1
+
+    out = {
+        "P": P, "N": N,
+        "cold_sweeps": int(cold_sweeps), "warm_sweeps": int(warm_sweeps),
+        "cold_ms": round(cold_ms, 1), "warm_ms": round(warm_ms, 1),
+        "warm_carry_hit": bool(warm_hit),
+        "identical": bool(np.array_equal(warm, cold)),
+    }
+    log(f"[delta-replan {P}x{N}] cold: {cold_sweeps} sweeps "
+        f"{cold_ms:.0f}ms / warm: {warm_sweeps} sweeps {warm_ms:.0f}ms "
+        f"(hit={warm_hit}, identical={out['identical']})")
+    return out
+
+
 def obs_summary():
     """The Recorder's aggregates, floats rounded for the JSON artifact:
     per-span-name totals (phase attribution), counters (solver sweeps,
@@ -464,6 +532,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes (code-path test on CPU)")
+    ap.add_argument("--perf-smoke", action="store_true",
+                    help="CI guard: run ONLY the delta-replan stage at "
+                         "smoke size on CPU and fail (exit 1) if the "
+                         "warm path does not beat the cold path's sweep "
+                         "count or diverges from it")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a Chrome trace-event JSON of every obs "
                          "span (open in chrome://tracing / Perfetto)")
@@ -474,6 +547,15 @@ def main():
 
     smoke = args.smoke
 
+    if args.perf_smoke:
+        # CI perf guard: CPU-pinned, delta-replan stage only, asserting
+        # the warm path's contract (fewer sweeps, identical map).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        _run_perf_smoke()
+        return
+
     # Fail fast if the device runtime is wedged: a hung tunnel makes
     # jax.devices() block forever inside native code (no Python timeout
     # can interrupt it), so probe it in a subprocess first.  Smoke runs
@@ -482,6 +564,7 @@ def main():
     # doesn't work — the axon plugin overrides JAX_PLATFORMS), and that
     # in-process pin cannot propagate to a probe subprocess, which would
     # then hang against the very runtime smoke mode exists to avoid.
+    backend_note = None
     if not smoke:
         import subprocess
 
@@ -499,28 +582,39 @@ def main():
                      "import jax, numpy; numpy.asarray("
                      "jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))"],
                     timeout=240, check=True, capture_output=True)
+                last = None  # a retry may succeed after a failed attempt
                 break
             except subprocess.TimeoutExpired:
                 last = "device probe (enumerate + tiny matmul) did not " \
                     "return within 240s — device runtime unreachable"
             except subprocess.CalledProcessError as e:
                 # Non-zero exit is deterministic (broken install/config),
-                # not a transient wedge — fail fast, no retries.
-                log(f"FATAL: device probe failed: "
-                    f"{e.stderr.decode()[-500:]}")
-                sys.exit(3)
+                # not a transient wedge — no retries, but still fall back
+                # to a measured CPU artifact below rather than aborting.
+                last = ("device probe failed: "
+                        + e.stderr.decode(errors="replace")[-500:])
+                break
             if attempt < attempts:
                 log(f"probe attempt {attempt}/{attempts} failed ({last}); "
                     f"retrying in 60s")
                 time.sleep(60)
-        else:
-            log(f"FATAL: {last}; aborting instead of hanging the driver. "
-                f"No device numbers were measurable this session; the "
-                f"latest builder-measured north-star artifact is "
-                f"docs/BENCH_local_r04.json (304 ms @ 100k x 10k, clean "
-                f"audit), and any partial progress from this run persists "
-                f"at docs/BENCH_progress.json.")
-            sys.exit(3)
+        if last is not None:
+            # The device runtime is unusable, but the driver still needs
+            # a PARSEABLE artifact (BENCH_r05: rc=3 left parsed=null).
+            # Pin the CPU platform in-process (the env var alone doesn't
+            # survive the axon plugin) and run the full pipeline at
+            # smoke sizes — every stage lands in the JSON, tagged
+            # "cpu-fallback" so nobody quotes the numbers as device
+            # measurements.
+            log(f"device unreachable ({last}); degrading to the "
+                f"cpu-fallback artifact at smoke sizes. The latest "
+                f"builder-measured north-star artifact remains "
+                f"docs/BENCH_local_r04.json (304 ms @ 100k x 10k).")
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            smoke = True
+            backend_note = "cpu-fallback"
 
     import jax
 
@@ -549,7 +643,7 @@ def main():
             # one worth reading.
             with trace(args.trace_out,
                        device_log_dir=args.device_trace_dir):
-                _run_benchmarks(smoke)
+                _run_benchmarks(smoke, backend_note)
         finally:
             if os.path.exists(args.trace_out):
                 log(f"obs: chrome trace written to {args.trace_out}")
@@ -557,10 +651,36 @@ def main():
         from blance_tpu.utils.trace import device_profile
 
         with device_profile(args.device_trace_dir):
-            _run_benchmarks(smoke)
+            _run_benchmarks(smoke, backend_note)
 
 
-def _run_benchmarks(smoke):
+def _run_perf_smoke():
+    """The CI perf gate (bench.py --perf-smoke): delta-replan at smoke
+    size on CPU; exit 1 when warm sweeps fail to beat cold sweeps or the
+    warm map diverges — so the warm path cannot silently regress to (or
+    past) a cold solve."""
+    import jax
+
+    log(f"perf-smoke on {jax.default_backend()}")
+    res = bench_delta_replan(512, 64)
+    ok = (res["identical"] and res["warm_carry_hit"]
+          and res["warm_sweeps"] * 2 <= res["cold_sweeps"])
+    print(json.dumps({
+        "metric": "delta-replan perf smoke (warm vs cold sweeps)",
+        "value": res["warm_sweeps"],
+        "unit": "sweeps",
+        "vs_baseline": res["cold_sweeps"],
+        "detail": res,
+        "pass": ok,
+    }))
+    if not ok:
+        log(f"PERF-SMOKE FAILED: warm={res['warm_sweeps']} sweeps vs "
+            f"cold={res['cold_sweeps']} (hit={res['warm_carry_hit']}, "
+            f"identical={res['identical']})")
+        sys.exit(1)
+
+
+def _run_benchmarks(smoke, backend_note=None):
     import jax
 
     # Verify at the LARGEST node count benched (the headline shape),
@@ -572,6 +692,7 @@ def _run_benchmarks(smoke):
     detail = {"configs": [], "pallas": pallas, "pallas_verified": pallas_ok,
               "fused_engine_verified": fused_ok,
               "device": str(jax.devices()[0]), "jax": jax.__version__,
+              "backend": backend_note or jax.default_backend(),
               "runs_per_config": RUNS}
     save_progress(detail, "verified")
 
@@ -673,8 +794,20 @@ def _run_benchmarks(smoke):
     except Exception as e:  # attribution detail — must not eat the solve
         log(f"pipeline stage failed ({type(e).__name__}: {first_line(e)})")
         detail["pipeline_error"] = first_line(e)
-    detail["obs"] = obs_summary()
     save_progress(detail, "pipeline done")
+
+    # Delta-replan stage: the incremental (warm-carry) replan against a
+    # cold solve of the identical delta — cold vs warm sweeps and
+    # wall-clock, plus the bit-identity contract.
+    try:
+        dp, dn = (512, 64) if smoke else (100_000, 1_000)
+        detail["delta_replan"] = bench_delta_replan(dp, dn)
+    except Exception as e:  # must not eat the solve numbers
+        log(f"delta-replan stage failed "
+            f"({type(e).__name__}: {first_line(e)})")
+        detail["delta_replan_error"] = first_line(e)
+    detail["obs"] = obs_summary()
+    save_progress(detail, "delta-replan done")
 
     if headline is None:
         # The headline config failed outright on every engine; fall back

@@ -150,6 +150,25 @@ class PlanOptions:
     # (fixes the reference quirk at plan.go:104-115).
     state_stickiness_standalone: bool = False
 
+    # --- backend selection / compilation ---
+    # backend="auto" routes to the batched TPU solver when
+    # P * N >= this threshold, else the exact native/greedy path.  None =
+    # the library default (plan/api.py _AUTO_TPU_THRESHOLD, 256 * 1024 —
+    # the crossover point where a device round-trip beats the sequential
+    # planner on the calibration hosts).  Deployments with faster
+    # interconnects or slower host CPUs should tune this down; tiny
+    # embedded runs with no accelerator, up.
+    auto_tpu_threshold: Optional[int] = None
+    # Opt-in static-shape bucketing for the pure plan_next_map path: pad
+    # P and N up to the next size bucket (core/encode.py bucket_size)
+    # before the device solve, so repeated calls against a drifting
+    # cluster reuse the compiled program instead of recompiling per
+    # (P, N).  Pad partitions are weight-0 and pad nodes invalid, so the
+    # padded solve's real rows match the unpadded solve's; the padding is
+    # stripped before decode.  Off by default: one-shot callers pay the
+    # up-to-12.5% padded-FLOPs cost for no reuse benefit.
+    shape_bucketing: bool = False
+
     # --- validation ---
     # Post-solve constraint audit on the batched (tpu) backend: duplicates,
     # placements on removed nodes, unfilled-but-feasible slots surface as

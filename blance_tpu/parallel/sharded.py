@@ -32,8 +32,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.encode import DenseProblem
-from ..plan.tensor import solve_dense_converged
+from ..core.encode import DenseProblem, pad_to
+from ..plan.tensor import (
+    SolveCarry,
+    _record_sweeps,
+    _warm_repair,
+    carry_from_assignment,
+    solve_dense_converged,
+)
+from ..obs import get_recorder
 
 # shard_map moved across JAX versions (jax.experimental.shard_map ->
 # top-level jax.shard_map); resolve once so the pinned CI versions and
@@ -134,11 +141,7 @@ def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
     affecting counts; their assignments are discarded at decode.
     """
     p = arr.shape[0]
-    rem = (-p) % multiple
-    if rem == 0:
-        return arr
-    pad_shape = (rem,) + arr.shape[1:]
-    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)], axis=0)
+    return pad_to(arr, 0, p + (-p) % multiple, fill)
 
 
 def pad_nodes(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
@@ -149,12 +152,27 @@ def pad_nodes(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
     only ever reference real node ids.
     """
     n = arr.shape[-1]
-    rem = (-n) % multiple
-    if rem == 0:
-        return arr
-    pad_shape = arr.shape[:-1] + (rem,)
-    return np.concatenate(
-        [arr, np.full(pad_shape, fill, arr.dtype)], axis=-1)
+    return pad_to(arr, arr.ndim - 1, n + (-n) % multiple, fill)
+
+
+def _build_checked(sm, checked_ok: bool):
+    """Build a shard_map'd fn, disabling the replication/vma checker
+    when the body's collectives confuse it (see solve_dense_sharded).
+
+    The disable kwarg has been renamed across JAX versions (check_vma
+    today, check_rep before); probe by retrying rather than inspecting,
+    so a version exposing neither still builds (and then simply runs
+    with the checker on)."""
+    if checked_ok:
+        return sm()
+    for kwargs in ({"check_vma": False}, {"check_rep": False}):
+        try:
+            return sm(**kwargs)
+        except TypeError:
+            continue
+    # Neither kwarg exists: build with the checker on, outside the try
+    # so a genuine shard_map TypeError propagates un-swallowed.
+    return sm()
 
 
 def solve_dense_sharded(
@@ -170,7 +188,11 @@ def solve_dense_sharded(
     rules: tuple,
     max_iterations: int = 10,
     fused_score: Optional[str] = None,
-) -> np.ndarray:
+    dirty: Optional[np.ndarray] = None,
+    carry: Optional[SolveCarry] = None,
+    return_carry: bool = False,
+    warm_only: bool = False,
+):
     """Run the converged solve under shard_map, partition axis sharded.
 
     Accepts a 1-D ("parts",) or 2-D ("parts", "nodes") mesh (make_mesh /
@@ -178,7 +200,21 @@ def solve_dense_sharded(
     solver are sharded on BOTH axes; inputs here stay partition-sharded +
     node-replicated ([N] vectors are small — the memory that matters is
     the solver's internal [P, N] score, which is what the node axis
-    splits).  Returns assign[P_original, S, R] (padding stripped).
+    splits).  Returns assign[P_original, S, R] (padding stripped), or
+    (assign, SolveCarry) with ``return_carry``.
+
+    With ``dirty`` + ``carry`` (both matching ``prev``) the solve runs
+    the WARM path first: one carry-seeded repair sweep under shard_map —
+    the carry's prices/used tables ride replicated along the node axis
+    while the assignment stays sharded over partitions — accepted when
+    the repair stayed inside the dirty mask (plan/tensor.py
+    solve_dense_warm semantics), else falling back to the cold fixpoint
+    below — or, with ``warm_only``, returning (None, None) so the
+    caller owns the fallback (and its metrics/audit gates, matching the
+    single-device solve_dense_warm contract).  Like the single-device
+    warm path, the carry is consumed either way.  ``carry_hit`` is not
+    counted here for the same reason as solve_dense_warm: the caller's
+    gates decide what a hit is.
     """
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_shards = axes[PARTITION_AXIS]
@@ -219,6 +255,80 @@ def solve_dense_sharded(
 
     shard = P(PARTITION_AXIS)
     rep = P()
+    # Pre-vma JAX (the check_rep model: no lax.pcast/pvary) has no
+    # replication rule for while_loop, so the checker must be off on ANY
+    # mesh there; vma-era JAX keeps it on for the plain 1-D matrix path.
+    # Off the 1-D matrix path: the output is node-replicated by
+    # construction — every node shard derives identical assignments from
+    # the all_gathered stats, a property tests/test_sharded_2d.py proves
+    # empirically (solves are bit-identical across node-shard counts) —
+    # but the varying-axes checker can't see through the all_gather/psum
+    # combine, so disable it on 2-D meshes.  The fused engine needs the
+    # same disable on ANY mesh: the checker's per-op vma propagation
+    # inside pallas_call rejects the kernel's mix of node-replicated [N]
+    # tables and partition-varying columns (its outputs carry correct
+    # vma annotations; the per-op walk is what can't see through).
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    checked_ok = has_vma and not node_axis and fused_score == "off"
+    device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+
+    dev_args = (
+        device_put(jnp.asarray(prev_p), shard),
+        device_put(jnp.asarray(pw_p), shard),
+        device_put(jnp.asarray(nw_p), rep),
+        device_put(jnp.asarray(valid_p), rep),
+        device_put(jnp.asarray(st_p), shard),
+        device_put(jnp.asarray(gids_p), rep),
+        device_put(jnp.asarray(gv_p), rep),
+    )
+
+    rec = get_recorder()
+    if dirty is not None and carry is not None:
+        # Warm repair sweep: dirty rides the partition axis (padding
+        # rows are marked dirty — their synthetic assignments must not
+        # read as a ripple), the carry's [S, N] fill table is replicated
+        # like every other [N]-shaped vector.
+        dirty_p = pad_partitions(
+            np.asarray(dirty, bool), n_shards, True)
+        cu = np.asarray(carry.used, np.float32)
+        if node_shards > 1:
+            cu = pad_nodes(cu, node_shards, 0.0)
+        rec.observe("plan.solve.dirty_fraction",
+                    float(np.asarray(dirty, bool).mean())
+                    if np.asarray(dirty).size else 0.0)
+        body_w = partial(
+            _warm_repair,
+            constraints=constraints, rules=rules,
+            axis_name=PARTITION_AXIS, node_axis=node_axis,
+            node_shards=node_shards, fused_score=fused_score)
+        sm_w = partial(_shard_map, body_w, mesh=mesh,
+                       in_specs=(shard, shard, rep, rep, shard, rep, rep,
+                                 shard, rep),
+                       out_specs=(shard, rep, rep))
+        fn_w = _build_checked(sm_w, checked_ok)
+        with rec.span("plan.solve.attempt", warm=True, sharded=True):
+            out, new_used, ok = fn_w(
+                *dev_args,
+                device_put(jnp.asarray(dirty_p), shard),
+                device_put(jnp.asarray(cu), rep))
+            accepted = bool(ok)
+        if accepted:
+            _record_sweeps(1)
+            rec.set_attr("warm", True)
+            assign = np.asarray(out)[:p_orig]
+            if not return_carry:
+                return assign
+            # Strip node padding: pad columns are invalid nodes with
+            # zero fill, and the session's carry is unpadded-N shaped.
+            n_orig = np.asarray(nweights).shape[-1]
+            used = jnp.asarray(np.asarray(new_used)[:, :n_orig])
+            return assign, SolveCarry(
+                prices=jnp.sum(used, axis=0), assign=jnp.asarray(assign),
+                used=used)
+        rec.count("plan.solve.warm_fallback")
+        rec.count("plan.solve.sweeps", 1)  # the executed repair pass
+        if warm_only:
+            return (None, None) if return_carry else None
 
     body = partial(
         solve_dense_converged,
@@ -233,49 +343,13 @@ def solve_dense_sharded(
     sm = partial(_shard_map, body, mesh=mesh,
                  in_specs=(shard, shard, rep, rep, shard, rep, rep),
                  out_specs=shard)
-    # Pre-vma JAX (the check_rep model: no lax.pcast/pvary) has no
-    # replication rule for while_loop, so the checker must be off on ANY
-    # mesh there; vma-era JAX keeps it on for the plain 1-D matrix path.
-    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
-    if has_vma and not node_axis and fused_score == "off":
-        fn = sm()
-    else:
-        # The output is node-replicated by construction — every node shard
-        # derives identical assignments from the all_gathered stats, a
-        # property tests/test_sharded_2d.py proves empirically (solves are
-        # bit-identical across node-shard counts) — but the varying-axes
-        # checker can't see through the all_gather/psum combine, so disable
-        # it on 2-D meshes.  The fused engine needs the same disable on
-        # ANY mesh: the checker's per-op vma propagation inside
-        # pallas_call rejects the kernel's mix of node-replicated [N]
-        # tables and partition-varying columns (its outputs carry correct
-        # vma annotations; the per-op walk is what can't see through).
-        # The disable kwarg has been renamed across JAX
-        # versions (check_vma today, check_rep before); probe by retrying
-        # rather than inspecting, so a version exposing neither still
-        # builds (and then simply runs with the checker on).
-        for kwargs in ({"check_vma": False}, {"check_rep": False}):
-            try:
-                fn = sm(**kwargs)
-                break
-            except TypeError:
-                continue
-        else:
-            # Neither kwarg exists: build with the checker on, outside the
-            # try so a genuine shard_map TypeError propagates un-swallowed.
-            fn = sm()
-
-    device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
-    assign = fn(
-        device_put(jnp.asarray(prev_p), shard),
-        device_put(jnp.asarray(pw_p), shard),
-        device_put(jnp.asarray(nw_p), rep),
-        device_put(jnp.asarray(valid_p), rep),
-        device_put(jnp.asarray(st_p), shard),
-        device_put(jnp.asarray(gids_p), rep),
-        device_put(jnp.asarray(gv_p), rep),
-    )
-    return np.asarray(assign)[:p_orig]
+    fn = _build_checked(sm, checked_ok)
+    assign = np.asarray(fn(*dev_args))[:p_orig]
+    if return_carry:
+        return assign, carry_from_assignment(
+            assign, np.asarray(pweights, np.float32),
+            np.asarray(nweights, np.float32))
+    return assign
 
 
 def solve_problem_sharded(

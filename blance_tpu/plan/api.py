@@ -23,7 +23,10 @@ from .greedy import plan_next_map_greedy
 __all__ = ["plan_next_map", "plan_next_map_legacy"]
 
 # Below this many (partitions x nodes), the exact greedy is faster than a
-# device round-trip; above it, the batched solver wins.
+# device round-trip; above it, the batched solver wins.  The library
+# default for backend="auto"; override per deployment with
+# PlanOptions.auto_tpu_threshold (the calibration behind this constant is
+# one host class — crossovers move with interconnect and host CPU).
 _AUTO_TPU_THRESHOLD = 256 * 1024
 
 
@@ -52,7 +55,10 @@ def plan_next_map(
     requested = backend
     if backend == "auto":
         size = len(partitions_to_assign) * len(nodes_all)
-        backend = "tpu" if size >= _AUTO_TPU_THRESHOLD else "native"
+        threshold = (_AUTO_TPU_THRESHOLD
+                     if opts.auto_tpu_threshold is None
+                     else int(opts.auto_tpu_threshold))
+        backend = "tpu" if size >= threshold else "native"
 
     with get_recorder().span(
             "plan.plan_next_map", backend=backend, requested=requested,

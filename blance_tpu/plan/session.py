@@ -19,6 +19,18 @@ the current dense assignment.  The steady-state loop is
 with PartitionMaps materializing only at the edges (``load_map`` /
 ``to_map``) for checkpoints and app hand-off.  An optional mesh runs the
 solve sharded over the partition axis (parallel/sharded.py).
+
+Replans are INCREMENTAL by default: every apply() promotes the solve's
+auction state (a plan.tensor.SolveCarry — prices, assignment, per-state
+fill) to the session's warm carry, and each cluster delta marks the
+partitions it can actually move in a dirty mask.  The next replan() then
+runs one carry-seeded repair sweep instead of the full cold fixpoint —
+bit-identical to the cold result by construction, at roughly half the
+sweeps — and falls back to the cold solve whenever the repair leaks
+outside the dirty mask, a capacity rail shrank under held load, the
+solve engine fails, or the post-solve audit flags a violation.  See
+docs/DESIGN.md "Incremental replanning" for the carry lifecycle and
+docs/OBSERVABILITY.md for the plan.solve.warm/carry_* signals.
 """
 
 from __future__ import annotations
@@ -70,6 +82,21 @@ class PlannerSession:
         # current/proposed dense assignments [P, S, R] int32, -1 = empty.
         self.current = self._problem.prev.copy()
         self.proposed: Optional[np.ndarray] = None
+        # Warm-start state (docs/DESIGN.md "Incremental replanning"):
+        # _carry is the SolveCarry matching ``current`` (valid iff
+        # _carry_current is literally the ``current`` array it was built
+        # against — identity, because every adoption path replaces the
+        # array); _pending_carry is the carry of ``proposed``, promoted
+        # by apply(); _dirty marks partitions a delta since the carry
+        # was built may move, and _dirty_post the marks from deltas
+        # recorded AFTER the pending proposal was solved (the proposal
+        # did not absorb those, so apply() must carry them forward, not
+        # clear them).
+        self._carry = None
+        self._carry_current: Optional[np.ndarray] = None
+        self._pending_carry = None
+        self._dirty = np.zeros(len(self._partition_names), bool)
+        self._dirty_post = np.zeros(len(self._partition_names), bool)
 
     # -- encoding ------------------------------------------------------------
 
@@ -93,13 +120,23 @@ class PlannerSession:
     # -- cluster membership ----------------------------------------------------
 
     def add_nodes(self, names: list[str]) -> None:
-        """Add nodes (new capacity attracts load on the next replan)."""
+        """Add nodes (new capacity attracts load on the next replan).
+
+        Dirty-mask delta: partitions with a holder in a hierarchy group
+        the new node joins are marked (their rule-tier floor may have
+        improved, so a warm repair must let them re-bid).  Balance-side
+        displacement — existing nodes' capacity share shrinking under the
+        grown cluster — is caught by replan()'s capacity precheck, which
+        routes grown clusters to the cold solve rather than guessing
+        which holders the trim pass will displace."""
         grew = False
+        added = []
         for n in names:
             self._removed.discard(n)
             if n not in self._node_index:
                 self._nodes.append(n)
                 self._node_index[n] = len(self._nodes) - 1
+                added.append(n)
                 grew = True
         if grew:
             current = self.current
@@ -111,16 +148,181 @@ class PlannerSession:
                     current.shape[:2] + (r_new - current.shape[2],),
                     -1, np.int32)
                 current = np.concatenate([current, pad], axis=2)
+                # ``current`` was replaced; the carry no longer matches
+                # any live assignment array.
+                self._carry = None
+                self._carry_current = None
             self.current = current
+            self._pad_carry_nodes()
+            self._mark_dirty_for_added(
+                [self._node_index[n] for n in added])
         else:
             self._problem.valid_node[:] = [
                 n not in self._removed for n in self._problem.nodes]
 
     def remove_nodes(self, names: list[str]) -> None:
-        """Mark nodes for removal: the next replan drains them."""
+        """Mark nodes for removal: the next replan drains them.
+
+        Dirty-mask delta: exactly the partitions holding a copy on a
+        removed node — a vectorized scan of ``current`` against the
+        removed ids (microseconds at the north-star scale)."""
         self._removed.update(names)
         self._problem.valid_node[:] = [
             n not in self._removed for n in self._problem.nodes]
+        ids = [self._node_index[n] for n in names if n in self._node_index]
+        if ids:
+            arr = np.asarray(ids, np.int32)
+            mask = np.isin(self.current, arr).any(axis=(1, 2))
+            if self.proposed is not None:
+                # The pending proposal may have moved load ONTO the
+                # victim: if it is adopted, those rows are the delta.
+                mask |= np.isin(self.proposed, arr).any(axis=(1, 2))
+            self._mark_dirty(mask)
+
+    def set_node_weights(self, node_weights: dict[str, int]) -> None:
+        """Re-weight nodes in place (capacity shares + score divisors).
+
+        A model/weight change re-prices every node, so the warm carry is
+        invalidated — the next replan solves cold and rebuilds it."""
+        self.opts.node_weights = dict(node_weights)
+        prob = self._problem
+        for ni, n in enumerate(prob.nodes):
+            prob.node_weights[ni] = node_weights.get(n, 1)
+        self.invalidate_carry()
+
+    def invalidate_carry(self) -> None:
+        """Drop the warm-start state: the next replan() solves cold.
+
+        Called automatically on load_map / weight changes; call it
+        manually after mutating ``current``, ``opts``, or the problem
+        arrays directly."""
+        self._carry = None
+        self._carry_current = None
+        self._pending_carry = None
+        self._dirty = np.zeros(len(self._partition_names), bool)
+        self._dirty_post = np.zeros(len(self._partition_names), bool)
+
+    # -- warm-start internals -------------------------------------------------
+
+    def _mark_dirty(self, mask: np.ndarray) -> None:
+        """Record delta marks.  Marks land in the post-proposal mask
+        while a proposal is pending: the pending solve did not see this
+        delta, so apply() must carry these forward instead of clearing
+        them with the absorbed ones."""
+        if self.proposed is not None:
+            self._dirty_post |= mask
+        else:
+            self._dirty |= mask
+
+    def _pad_carry_nodes(self) -> None:
+        """Grow the carries' [N]-shaped arrays after add_nodes: fresh
+        nodes hold nothing, so zero-fill keeps them exact.  BOTH the
+        live carry and the pending one (a delta can land between
+        replan() and apply(), and apply() will promote the pending
+        carry into the grown problem)."""
+        n = self._problem.N
+        self._carry = self._pad_one_carry(self._carry, n)
+        self._pending_carry = self._pad_one_carry(self._pending_carry, n)
+
+    @staticmethod
+    def _pad_one_carry(carry, n: int):
+        if carry is None:
+            return None
+        used = np.asarray(carry.used)
+        if used.shape[1] >= n:
+            return carry
+        from .tensor import SolveCarry
+
+        used = np.concatenate(
+            [used, np.zeros((used.shape[0], n - used.shape[1]),
+                            used.dtype)], axis=1)
+        return SolveCarry(prices=used.sum(axis=0), assign=carry.assign,
+                          used=used)
+
+    def _mark_dirty_for_added(self, new_ids: list[int]) -> None:
+        """Adds can improve a partition's attainable rule tier: any
+        partition holding a copy in a hierarchy group the new node
+        joins may now prefer the new node for rule reasons, so it must
+        be allowed to re-bid under a warm repair."""
+        prob = self._problem
+        if not new_ids or not prob.rules or not self.current.size:
+            return
+        assigns = [self.current]
+        if self.proposed is not None:
+            assigns.append(self.proposed)
+        levels = {inc for rl in prob.rules.values() for (inc, _exc) in rl}
+        for a_arr in assigns:
+            held = a_arr >= 0
+            cur = np.clip(a_arr, 0, prob.N - 1)
+            for lv in levels:
+                for a in new_ids:
+                    if not prob.gid_valid[lv, a]:
+                        continue
+                    g = prob.gids[lv, a]
+                    self._mark_dirty(
+                        ((prob.gids[lv][cur] == g) & held).any(axis=(1, 2)))
+
+    def _effective_dirty(self) -> np.ndarray:
+        """The replan-time dirty mask: accumulated delta rows plus any
+        partition with an unfilled constrained slot (it must bid)."""
+        prob = self._problem
+        d = self._dirty.copy()
+        r = self.current.shape[2]
+        for si in range(prob.S):
+            k = min(int(prob.constraints[si]), r)
+            if k > 0:
+                d |= (self.current[:, si, :k] < 0).any(axis=1)
+        return d
+
+    def _capacity_shrank(self, carry, dirty: np.ndarray) -> bool:
+        """True when some node's clean-row held weight exceeds its new
+        per-state capacity rail — the pin pass would then trim (displace)
+        holders OUTSIDE the dirty mask, so a warm repair cannot be
+        accepted and the cold solve should run directly (skipping the
+        wasted repair sweep).  O(N + dirty) host work off the carry.
+
+        Grants the same quantization allowance as the device-side
+        acceptance check (plan/tensor.py _warm_repair): a converged
+        fixpoint legitimately overshoots the ceil'd rail by up to one
+        max-weight partition per shard (the auction's first-bidder
+        progress rule) and replans unchanged, so flagging that steady
+        state would silently demote every replan of such a session to
+        cold.  A mis-grant only costs a wasted repair sweep — the
+        in-graph ripple check still falls back when the trim actually
+        displaces clean holders."""
+        prob = self._problem
+        used = np.asarray(carry.used)
+        pw = prob.partition_weights
+        total_w = float(pw.sum())
+        cap_w = np.where(
+            prob.valid_node & (prob.node_weights >= 0),
+            np.maximum(prob.node_weights, 1.0), 0.0).astype(np.float64)
+        share = cap_w / max(cap_w.sum(), 1.0)
+        r = self.current.shape[2]
+        any_dirty = bool(dirty.any())
+        shards = 1
+        if self.mesh is not None:
+            from ..parallel.sharded import PARTITION_AXIS
+
+            axes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            shards = axes.get(PARTITION_AXIS, 1)
+        allowance = shards * (float(pw.max()) if pw.size else 0.0)
+        for si in range(prob.S):
+            k = int(prob.constraints[si])
+            if k <= 0:
+                continue
+            held = used[si].astype(np.float64).copy()
+            if any_dirty:
+                # Dirty rows re-bid regardless; their held weight cannot
+                # pin, so it does not count against the rail.
+                ids = self.current[dirty, si, :].ravel()
+                w = np.repeat(pw[dirty], r)
+                m = ids >= 0
+                np.subtract.at(held, ids[m], w[m])
+            cap = np.ceil(k * total_w * share)
+            if (held > cap + allowance + 1e-6).any():
+                return True
+        return False
 
     @property
     def nodes(self) -> list[str]:
@@ -163,6 +365,7 @@ class PlannerSession:
         self._reencode(prev_map=prev_map)
         self.current = self._problem.prev.copy()
         self.proposed = None
+        self.invalidate_carry()  # the adopted map is a cold start
 
     def to_map(
         self, which: str = "current"
@@ -183,10 +386,20 @@ class PlannerSession:
 
     def replan(self) -> np.ndarray:
         """Solve placement from ``current`` on device; stores and returns
-        the proposed assignment (does not adopt it — see apply())."""
+        the proposed assignment (does not adopt it — see apply()).
+
+        Incremental by default: with a valid warm carry (built by the
+        previous replan, promoted by apply()) the solve is one
+        carry-seeded repair sweep restricted to the delta's dirty rows —
+        bit-identical to the cold fixpoint, at a fraction of the sweeps.
+        Falls back to the cold solve when the carry is missing/stale,
+        capacity shrank under held load, the repair leaked outside the
+        dirty mask, the engine failed, or the post-solve audit found a
+        violation (docs/DESIGN.md "Incremental replanning")."""
         import jax.numpy as jnp
 
         from . import tensor as _tensor
+        from ..obs import get_recorder
         from .tensor import resolve_default_fused_score
 
         prob = self._problem
@@ -196,34 +409,135 @@ class PlannerSession:
             self.proposed = self.current.copy()
             return self.proposed
 
+        rec = get_recorder()
         iters = max(int(self.opts.max_iterations), 1)
-        if self.mesh is not None:
-            from ..parallel.sharded import solve_dense_sharded
+        mode = resolve_default_fused_score(prob.P, prob.N)
 
-            assign = solve_dense_sharded(
-                self.mesh, self.current, prob.partition_weights,
-                prob.node_weights, prob.valid_node, prob.stickiness,
-                prob.gids, prob.gid_valid, constraints, rules,
-                max_iterations=iters)
-        else:
-            assign, _engine = _tensor.solve_converged_resilient(
-                jnp.asarray(self.current),
-                jnp.asarray(prob.partition_weights),
-                jnp.asarray(prob.node_weights),
-                jnp.asarray(prob.valid_node),
-                jnp.asarray(prob.stickiness),
-                jnp.asarray(prob.gids),
-                jnp.asarray(prob.gid_valid),
-                constraints, rules, max_iterations=iters,
-                mode=resolve_default_fused_score(prob.P, prob.N),
-                allow_fallback=_tensor._FUSED_SCORE_DEFAULT == "auto",
-                context="PlannerSession.replan")
+        # This solve absorbs every delta recorded so far — including any
+        # that arrived after a previous (unapplied) proposal.
+        self._dirty |= self._dirty_post
+        self._dirty_post[:] = False
+
+        # Warm attempt: consume the carry (its buffers may be donated
+        # into the repair), accept only a delta-contained repair.
+        carry, self._carry = self._carry, None
+        warm_ok = carry is not None and self._carry_current is self.current
+        if not warm_ok:
+            rec.count("plan.solve.carry_miss")
+        self._carry_current = None
+        assign = new_carry = None
+        if warm_ok:
+            dirty = self._effective_dirty()
+            if self._capacity_shrank(carry, dirty):
+                # Grown cluster: the trim pass will displace clean
+                # holders — the repair could never be accepted, so skip
+                # straight to cold instead of wasting a sweep.
+                rec.count("plan.solve.carry_miss")
+            else:
+                assign, new_carry = self._warm_solve(
+                    carry, dirty, constraints, rules, mode)
+                if assign is not None and self._audit_gate(prob, assign):
+                    # Constraint violation in the repaired result: the
+                    # warm shortcut is not trustworthy here — cold-solve.
+                    rec.count("plan.solve.warm_fallback")
+                    assign = new_carry = None
+                if assign is not None:
+                    # A hit means the replan really did cost one sweep
+                    # end-to-end: counted only after every gate (device
+                    # acceptance AND the audit) passed.
+                    rec.count("plan.solve.carry_hit")
+
+        if assign is None:
+            if self.mesh is not None:
+                from ..parallel.sharded import solve_dense_sharded
+
+                assign, new_carry = solve_dense_sharded(
+                    self.mesh, self.current, prob.partition_weights,
+                    prob.node_weights, prob.valid_node, prob.stickiness,
+                    prob.gids, prob.gid_valid, constraints, rules,
+                    max_iterations=iters, return_carry=True)
+            else:
+                assign, _engine, new_carry = \
+                    _tensor.solve_converged_resilient(
+                        jnp.asarray(self.current),
+                        jnp.asarray(prob.partition_weights),
+                        jnp.asarray(prob.node_weights),
+                        jnp.asarray(prob.valid_node),
+                        jnp.asarray(prob.stickiness),
+                        jnp.asarray(prob.gids),
+                        jnp.asarray(prob.gid_valid),
+                        constraints, rules, max_iterations=iters,
+                        mode=mode,
+                        allow_fallback=_tensor._FUSED_SCORE_DEFAULT
+                        == "auto",
+                        context="PlannerSession.replan",
+                        return_carry=True)
         from .tensor import maybe_validate
 
         maybe_validate(prob, assign, self.opts.validate_assignment,
                        "PlannerSession.replan")
         self.proposed = assign
+        self._pending_carry = new_carry
         return assign
+
+    def _warm_solve(self, carry, dirty, constraints, rules, mode):
+        """One warm repair attempt; (None, None) on decline/failure."""
+        from . import tensor as _tensor
+        from ..obs import get_recorder
+
+        prob = self._problem
+        try:
+            if self.mesh is not None:
+                from ..parallel.sharded import solve_dense_sharded
+
+                return solve_dense_sharded(
+                    self.mesh, self.current, prob.partition_weights,
+                    prob.node_weights, prob.valid_node, prob.stickiness,
+                    prob.gids, prob.gid_valid, constraints, rules,
+                    dirty=dirty, carry=carry, return_carry=True,
+                    warm_only=True)
+            # No p_real: the warm repair must run the exact arithmetic
+            # of the session's cold path (both leave total_p a
+            # compile-time constant), or low-bit differences would read
+            # as divergence from the cold fixpoint.
+            return _tensor.solve_dense_warm(
+                self.current, prob.partition_weights, prob.node_weights,
+                prob.valid_node, prob.stickiness, prob.gids,
+                prob.gid_valid, constraints, rules, dirty=dirty,
+                carry=carry, fused_score=mode)
+        except (ValueError, TypeError):
+            raise  # deterministic input errors: same on the cold path
+        except Exception as e:
+            # Engine/runtime failure during the repair (HBM, lowering):
+            # degrade to the cold resilient path, which has its own
+            # engine fallback — never let the warm shortcut be the
+            # reason a replan errors.
+            import warnings as _warnings
+
+            first = (str(e).splitlines() or [""])[0][:200]
+            _warnings.warn(
+                f"blance_tpu PlannerSession.replan: warm repair failed "
+                f"({type(e).__name__}: {first}); falling back to a cold "
+                f"solve", UserWarning, stacklevel=3)
+            get_recorder().count("plan.solve.warm_fallback")
+            return None, None
+
+    def _audit_gate(self, prob, assign) -> bool:
+        """True when the audit policy is active AND finds violations —
+        the warm path's fall-back-to-cold condition.  Respects
+        opts.validate_assignment exactly like maybe_validate (None =
+        auto), so explicitly disabled validation also disables the
+        gate."""
+        from .tensor import _audit_rules_nest, _VALIDATE_AUTO_CELLS, \
+            check_assignment
+
+        validate = self.opts.validate_assignment
+        if validate is None:
+            validate = _audit_rules_nest(prob) or \
+                prob.P * prob.N <= _VALIDATE_AUTO_CELLS
+        if not validate:
+            return False
+        return any(check_assignment(prob, assign).values())
 
     def moves(
         self, favor_min_nodes: bool = False
@@ -253,8 +567,20 @@ class PlannerSession:
 
     def apply(self) -> None:
         """Adopt the proposed assignment as current (the app moved the
-        data); removed nodes no longer hold anything after this."""
+        data); removed nodes no longer hold anything after this.
+
+        Also promotes the solve's carry to the session's warm-start
+        state and retires the dirty marks the adopted solve absorbed;
+        marks from deltas recorded AFTER that solve ran (held in the
+        post-proposal mask) carry forward, so the next warm replan still
+        re-bids exactly the partitions those deltas can move."""
         if self.proposed is None:
             raise ValueError("no proposed assignment; call replan() first")
         self.current = self.proposed
         self.proposed = None
+        self._carry = self._pending_carry
+        self._carry_current = self.current if self._carry is not None \
+            else None
+        self._pending_carry = None
+        self._dirty = self._dirty_post
+        self._dirty_post = np.zeros(len(self._partition_names), bool)

@@ -36,7 +36,7 @@ stay globally consistent while scores stay local.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -60,7 +60,8 @@ from ..ops.score_fused import (
 )
 
 __all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
-           "solve_converged_resilient", "check_assignment", "maybe_validate"]
+           "solve_converged_resilient", "solve_dense_warm", "SolveCarry",
+           "carry_from_assignment", "check_assignment", "maybe_validate"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -140,6 +141,71 @@ def resolve_default_fused_score(p: int, n: int) -> str:
     PlannerSession.replan, future callers) uses to turn the module
     default into a concrete jit-safe mode."""
     return resolve_fused_score(_FUSED_SCORE_DEFAULT, p, n)
+
+
+class SolveCarry(NamedTuple):
+    """Auction state carried across delta replans (the warm start).
+
+    A converged solve is a fixpoint: replaying it against the same
+    problem re-derives the same per-node fill (the quantity that prices
+    the score's balance term) from scratch.  The carry keeps that state
+    alive between replans so a delta replan seeds the solver instead of
+    re-deriving it, and — more importantly — so the fixpoint loop's
+    confirming sweep can be skipped when the repair provably stayed
+    inside the delta (see :func:`solve_dense_warm`).
+
+    ``used`` is the ground truth; ``prices`` is its per-node sum (the
+    total fill vector the balance term divides), kept explicit so
+    callers can run O(N) host prechecks (capacity-shrink detection)
+    without touching the [S, N] table.
+
+    Fields
+    ------
+    prices: [N] f32 — total per-node weighted fill at convergence.
+    assign: [P, S, R] i32 — the converged assignment the carry matches.
+        A carry is only valid against a ``prev`` equal to this array;
+        sessions enforce that by identity (plan/session.py).
+    used:   [S, N] f32 — per-state per-node accepted weight, built with
+        the SAME scatter the solver's seed pass uses, so seeding from it
+        is bitwise identical to recomputing from ``assign``.
+    """
+
+    prices: jnp.ndarray
+    assign: jnp.ndarray
+    used: jnp.ndarray
+
+
+def _used_by_state(assign, pweights, n, s, axis_name=None):
+    """[S, N] per-state weighted fill — the carry's ``used`` table.
+
+    One :func:`_scatter_counts` per state followed by a psum, exactly
+    the ops (and op order) of solve_dense's seed pass, so a warm solve
+    seeded from this table computes bit-identical totals."""
+    return jnp.stack([
+        _psum(_scatter_counts(assign[:, si, :], pweights, n), axis_name)
+        for si in range(s)])
+
+
+@jax.jit
+def _carry_used_jit(assign, pweights, nweights):
+    """Single-device spelling of :func:`_used_by_state` (for building a
+    carry from a host-side assignment, e.g. after a cold solve)."""
+    return _used_by_state(
+        assign, pweights, nweights.shape[0], assign.shape[1])
+
+
+def carry_from_assignment(assign, pweights, nweights) -> SolveCarry:
+    """Package a converged assignment as a :class:`SolveCarry`.
+
+    Use after any cold solve whose output will seed future delta
+    replans.  ``used`` comes from the same device scatter the solver's
+    seed pass runs, so the next warm solve's totals match a cold
+    recompute bit-for-bit."""
+    assign = jnp.asarray(assign)
+    used = _carry_used_jit(assign, jnp.asarray(pweights),
+                           jnp.asarray(nweights))
+    return SolveCarry(prices=jnp.sum(used, axis=0), assign=assign,
+                      used=used)
 
 
 def _drop_empty(ids: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -851,6 +917,18 @@ def solve_dense(
     fused_score: str = "off",  # static; "off" = materialized score matrix,
     # "on" = in-kernel score (ops/score_fused.py, TPU), "interpret" =
     # in-kernel via the pallas interpreter (CPU tests)
+    carry_used: Optional[jnp.ndarray] = None,  # [S, N] warm-start seed:
+    # per-state per-node fill from the previous converged solve
+    # (SolveCarry.used).  MUST equal the scatter of ``prev`` (the session
+    # invalidates the carry whenever prev drifts); seeding replaces the
+    # S + 1 seed scatters with lookups, bit-identically.
+    p_real: Optional[jnp.ndarray] = None,  # traced scalar: the GLOBAL
+    # count of REAL partitions when the arrays carry inert padding rows
+    # (shape bucketing).  Keeps the advisory fill factor's denominator —
+    # the one place the partition COUNT (not weight) enters the score —
+    # identical to the unpadded solve, so bucketing is bit-neutral.
+    # Traced, not static: drifting real sizes inside one bucket must not
+    # retrigger compilation.
 ) -> jnp.ndarray:
     """Solve the whole placement problem on device; returns assign[P, S, R].
 
@@ -881,7 +959,10 @@ def solve_dense(
     valid_l = _node_slice(valid, node_axis, n_l)
     gids_l = _node_slice(gids, node_axis, n_l)
 
-    total_p = _psum(jnp.array(p, jnp.float32), axis_name)
+    if p_real is not None:
+        total_p = jnp.asarray(p_real, jnp.float32)  # global: no psum
+    else:
+        total_p = _psum(jnp.array(p, jnp.float32), axis_name)
     total_w = _psum(jnp.sum(pweights), axis_name)
 
     w_div = jnp.where(nweights > 0, nweights, 1.0)
@@ -913,10 +994,16 @@ def solve_dense(
     # carrying intra-wave counts across slots lets +-cap quantization noise
     # (several units) swamp the 1.5 stickiness bonus and cause churn.  The
     # capacity rail + in-slot price own balance instead.
-    total = jnp.sum(
-        jnp.stack([_scatter_counts(prev[:, si, :], pweights, n)
-                   for si in range(s)]), axis=0)
-    total = _psum(total, axis_name)
+    # A warm start reads the seed straight off the carry (which was built
+    # with the same per-state scatters, in the same summation order, from
+    # the same assignment) instead of re-scattering prev.
+    if carry_used is not None:
+        total = jnp.sum(carry_used, axis=0)
+    else:
+        total = jnp.sum(
+            jnp.stack([_scatter_counts(prev[:, si, :], pweights, n)
+                       for si in range(s)]), axis=0)
+        total = _psum(total, axis_name)
 
     assign = jnp.full((p, s, r_max), -1, jnp.int32)
     # Nodes already holding this partition at an equal-or-higher priority
@@ -938,9 +1025,13 @@ def solve_dense(
 
         # All of this state's prev holders re-assign in this wave: remove
         # their seed contribution up front (the batch analog of the
-        # per-partition decrement at plan.go:290-297).
-        state_prev = _psum(_scatter_counts(prev[:, si, :], pweights, n),
-                           axis_name)
+        # per-partition decrement at plan.go:290-297).  Warm starts read
+        # the per-state row off the carry (same psum-of-scatter, bitwise).
+        if carry_used is not None:
+            state_prev = carry_used[si]
+        else:
+            state_prev = _psum(_scatter_counts(prev[:, si, :], pweights, n),
+                               axis_name)
         total = total - state_prev
 
         # Held this state before (fusable compares, no scatter).
@@ -1254,14 +1345,21 @@ def _solve_dense_converged_impl(
     node_axis: Optional[str] = None,
     node_shards: int = 1,
     fused_score: str = "off",
+    carry_used: Optional[jnp.ndarray] = None,
+    p_real: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Jitted fixpoint body; returns (assign, sweeps-executed)."""
-    def solve(x):
+    """Jitted fixpoint body; returns (assign, sweeps-executed).
+
+    ``carry_used`` seeds the FIRST sweep only — like cluster deltas
+    (plan.go:49-55), the carry describes the state the loop starts from;
+    later sweeps re-derive their seed from their own input."""
+    def solve(x, cu=None):
         return solve_dense(x, pweights, nweights, valid, stickiness,
                            gids, gid_valid, constraints, rules, axis_name,
-                           node_axis, node_shards, fused_score)
+                           node_axis, node_shards, fused_score,
+                           carry_used=cu, p_real=p_real)
 
-    first = solve(prev)
+    first = solve(prev, carry_used)
 
     def cond(carry):
         out, prev_i, it = carry
@@ -1313,6 +1411,9 @@ def solve_dense_converged(
     node_shards: int = 1,
     fused_score: str = "off",
     record: bool = True,
+    carry_used: Optional[jnp.ndarray] = None,
+    return_carry: bool = False,
+    p_real: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """solve_dense iterated to a fixpoint (reference plan.go:23-58).
 
@@ -1331,21 +1432,182 @@ def solve_dense_converged(
     costs one scalar device-to-host sync; ``record=False`` skips that —
     for micro-timed loops where an extra host round-trip would perturb
     the measurement (under jit/shard_map tracing it is skipped anyway).
+
+    ``carry_used`` (SolveCarry.used matching ``prev``) seeds the first
+    sweep's fill totals bit-identically instead of re-scattering them;
+    ``return_carry`` additionally packages the converged output as a
+    :class:`SolveCarry` for the next delta replan — returns
+    (assign, carry) instead of assign.  (Not usable under an outer
+    jit/shard_map trace; the sharded entry point builds its carry
+    host-side instead.)
     """
     out, sweeps = _solve_dense_converged_impl(
         prev, pweights, nweights, valid, stickiness, gids, gid_valid,
         constraints, rules, axis_name, max_iterations, node_axis,
-        node_shards, fused_score)
+        node_shards, fused_score, carry_used, p_real)
     if record:
         _record_sweeps(sweeps)
+    if return_carry:
+        return out, carry_from_assignment(out, pweights, nweights)
     return out
+
+
+def _warm_repair(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    dirty: jnp.ndarray,  # [P] bool — partitions the delta may move
+    carry_used: jnp.ndarray,  # [S, N] SolveCarry.used matching prev
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
+    fused_score: str = "off",
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE carry-seeded repair sweep + in-graph acceptance flags.
+
+    The repair sweep is ``solve_dense`` itself (same trace, totals seeded
+    bit-identically from the carry), so its output equals a cold solve's
+    first sweep exactly; only re-bidding partitions — dirty rows, plus
+    anything the pin pass displaces — do any auction work, while
+    untouched rows keep their pinned placement.  What a warm replan
+    SKIPS is the fixpoint loop's confirming sweep(s), and that skip is
+    only sound when the repair provably stayed inside the delta.  Two
+    device-side checks decide, without host round-trips per condition:
+
+    - ripple: any row OUTSIDE the dirty mask changed — the delta leaked
+      (capacity trim displaced clean holders, a tier floor shifted); a
+      second sweep could move more, so the caller must cold-solve.
+    - fresh over-capacity: a node's new fill exceeds its state rail by
+      more than the quantization allowance AND exceeds its previous
+      fill — a sign the repair force-packed displaced copies where a
+      confirming sweep would re-balance them.  The allowance is one
+      max-weight partition per shard: the auction's first-bidder
+      progress rule legitimately overshoots the ceil'd rail by up to
+      that much, and such fixpoints replan unchanged (the overshoot
+      sits inside the pin pass's lmin+stickiness band — see
+      _pin_prev_holders), so flagging them would demote every
+      steady-state sharded replan to cold.  Rails the PREVIOUS solution
+      already exceeded (rule-constrained overflow the top-up
+      deliberately grants) don't trip this either.
+
+    Returns (assign, new_used[S, N], ok) where ``ok`` (scalar bool,
+    globally agreed under shard_map) means "accept this as converged".
+    """
+    p, s, _ = prev.shape
+    n = nweights.shape[0]
+    out = solve_dense(prev, pweights, nweights, valid, stickiness, gids,
+                      gid_valid, constraints, rules, axis_name, node_axis,
+                      node_shards, fused_score, carry_used=carry_used,
+                      p_real=p_real)
+    new_used = _used_by_state(out, pweights, n, s, axis_name)
+
+    rippled = jnp.any((out != prev) & ~dirty[:, None, None])
+    if axis_name:
+        rippled = lax.psum(rippled.astype(jnp.int32), axis_name) > 0
+
+    total_w = _psum(jnp.sum(pweights), axis_name)
+    cap_w = jnp.where(valid & (nweights >= 0), jnp.maximum(nweights, 1.0),
+                      0.0)
+    cap_share = cap_w / jnp.maximum(jnp.sum(cap_w), 1.0)
+    ns = _axis_size(axis_name) if axis_name else 1
+    max_w = jnp.max(pweights) if p else jnp.float32(0.0)
+    if axis_name:
+        max_w = lax.pmax(max_w, axis_name)
+    allowance = ns * max_w  # first-bidder quantization, one per shard
+    overcap = jnp.array(False)
+    for si, k in enumerate(constraints):
+        if k <= 0:
+            continue
+        rail = jnp.ceil(k * total_w * cap_share)
+        overcap |= jnp.any((new_used[si] > rail + allowance)
+                           & (new_used[si] > carry_used[si]))
+    ok = ~rippled & ~overcap
+    return out, new_used, ok
+
+
+_WARM_STATICS = ("constraints", "rules", "axis_name", "node_axis",
+                 "node_shards", "fused_score")
+_warm_repair_jit = partial(jax.jit, static_argnames=_WARM_STATICS)(
+    _warm_repair)
+# Donating prev + carry_used lets XLA alias them into the outputs (same
+# shapes/dtypes), so a steady-state warm replan reuses the previous
+# carry's buffers instead of allocating: the carry is single-use by
+# contract (sessions drop theirs after every attempt).  CPU buffers are
+# not donatable (dispatch would warn every call), so the plain jit backs
+# host runs and tests.
+_warm_repair_donating = jax.jit(
+    _warm_repair, static_argnames=_WARM_STATICS,
+    donate_argnames=("prev", "carry_used"))
+
+
+def solve_dense_warm(
+    prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+    constraints, rules, *, dirty, carry: SolveCarry,
+    fused_score: str = "off", record: bool = True,
+    donate: Optional[bool] = None, p_real=None,
+) -> tuple[Optional[np.ndarray], Optional[SolveCarry]]:
+    """Warm delta replan: repair sweep from the carry, or decline.
+
+    Returns (assign, next_carry) when the repair is accepted as
+    converged — one sweep instead of the cold fixpoint's two-plus — or
+    (None, None) when the delta leaked outside the dirty mask and the
+    caller must run the cold path (:func:`solve_converged_resilient`).
+    The carry is CONSUMED either way (its device buffers may be donated
+    into the repair); callers must replace it with ``next_carry`` or the
+    cold solve's rebuilt carry, never reuse it.
+
+    obs: records ``plan.solve.dirty_fraction`` (histogram), a
+    ``plan.solve.warm_fallback`` counter on decline, the executed sweep
+    in ``plan.solve.sweeps``, and a ``warm`` span attribute on
+    acceptance.  ``plan.solve.carry_hit`` is deliberately NOT counted
+    here: the caller may still reject the accepted repair (the
+    session's audit gate), and a hit must mean the replan really did
+    cost one sweep end-to-end — callers count it once their own gates
+    pass.
+    """
+    rec = get_recorder()
+    dirty_np = np.asarray(dirty)
+    if record:
+        rec.observe("plan.solve.dirty_fraction",
+                    float(dirty_np.mean()) if dirty_np.size else 0.0)
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    impl = _warm_repair_donating if donate else _warm_repair_jit
+    with rec.span("plan.solve.attempt", warm=True,
+                  engine={"off": "matrix", "on": "fused",
+                          "interpret": "fused-interpret"}[fused_score]):
+        out, new_used, ok = impl(
+            jnp.asarray(prev), jnp.asarray(pweights), jnp.asarray(nweights),
+            jnp.asarray(valid), jnp.asarray(stickiness), jnp.asarray(gids),
+            jnp.asarray(gid_valid), jnp.asarray(dirty_np),
+            jnp.asarray(carry.used), constraints=constraints, rules=rules,
+            fused_score=fused_score, p_real=p_real)
+        accepted = bool(ok)
+    if not accepted:
+        if record:
+            rec.count("plan.solve.warm_fallback")
+            rec.count("plan.solve.sweeps", 1)  # the executed repair pass
+        return None, None
+    if record:
+        _record_sweeps(1)
+        rec.set_attr("warm", True)
+    return np.asarray(out), SolveCarry(
+        prices=jnp.sum(new_used, axis=0), assign=out, used=new_used)
 
 
 def solve_converged_resilient(
     prev, pweights, nweights, valid, stickiness, gids, gid_valid,
     constraints, rules, *, max_iterations: int, mode: str,
     allow_fallback: bool, context: str, timer=None,
-) -> tuple[np.ndarray, str]:
+    carry_used=None, return_carry: bool = False, p_real=None,
+):
     """solve_dense_converged with engine-failure degradation.
 
     The auto-selected engine is a prediction from a working-set model
@@ -1357,7 +1619,9 @@ def solve_converged_resilient(
     explicit user choice) a failed engine retries once on the opposite
     one, surfacing the switch as a UserWarning and on the timer's
     annotations — so production callers degrade exactly like bench.py
-    does, instead of erroring.  Returns (assignment, engine-that-ran).
+    does, instead of erroring.  Returns (assignment, engine-that-ran),
+    plus the rebuilt :class:`SolveCarry` when ``return_carry`` is set.
+    ``carry_used`` seeds the first sweep (see solve_dense_converged).
     """
     import warnings as _warnings
 
@@ -1370,7 +1634,8 @@ def solve_converged_resilient(
             return np.asarray(solve_dense_converged(
                 prev, pweights, nweights, valid, stickiness, gids,
                 gid_valid, constraints, rules,
-                max_iterations=max_iterations, fused_score=m))
+                max_iterations=max_iterations, fused_score=m,
+                carry_used=carry_used, p_real=p_real))
 
     try:
         out = run(mode)
@@ -1406,6 +1671,8 @@ def solve_converged_resilient(
         timer.annotate("engine", engine)
     else:
         rec.set_attr("engine", engine)
+    if return_carry:
+        return out, mode, carry_from_assignment(out, pweights, nweights)
     return out, mode
 
 
@@ -1838,24 +2105,68 @@ def plan_next_map_tpu(
         tuple(problem.rules.get(si, ())) for si in range(problem.S))
     constraints = tuple(int(c) for c in problem.constraints)
 
+    # Opt-in static-shape bucketing (PlanOptions.shape_bucketing): pad
+    # P and N up to the next bucket so repeated pure-path calls against a
+    # drifting cluster hit the jit cache instead of recompiling — keeping
+    # shapes static is what makes repeated invocation cheap (GSPMD,
+    # arXiv:2105.04663).  Pad partitions are weight-0 bidders (their
+    # assignments are sliced off below) and pad nodes invalid
+    # (valid=False => zero capacity, +INF score, gid_valid=False), the
+    # same inert-padding contract parallel/sharded.py relies on, so the
+    # real rows solve identically to the unpadded problem.
+    prev_a = problem.prev
+    pw_a = problem.partition_weights
+    nw_a = problem.node_weights
+    valid_a = problem.valid_node
+    stick_a = problem.stickiness
+    gids_a = problem.gids
+    gv_a = problem.gid_valid
+    solve_p, solve_n = problem.P, problem.N
+    if opts.shape_bucketing:
+        from ..core.encode import bucket_size, pad_to
+
+        solve_p = bucket_size(problem.P)
+        solve_n = bucket_size(problem.N)
+        prev_a = pad_to(prev_a, 0, solve_p, -1)
+        pw_a = pad_to(pw_a, 0, solve_p, 0.0)
+        stick_a = pad_to(stick_a, 0, solve_p, 0.0)
+        nw_a = pad_to(nw_a, 0, solve_n, 1.0)
+        valid_a = pad_to(valid_a, 0, solve_n, False)
+        gids_a = pad_to(gids_a, 1, solve_n, -1)
+        gv_a = pad_to(gv_a, 1, solve_n, False)
+
     with phase_span("plan.solve", timer=timer,
-                    partitions=problem.P, nodes=problem.N):
+                    partitions=problem.P, nodes=problem.N,
+                    bucketed_shape=((solve_p, solve_n)
+                                    if opts.shape_bucketing else None)):
         assign, _engine = solve_converged_resilient(
-            jnp.asarray(problem.prev),
-            jnp.asarray(problem.partition_weights),
-            jnp.asarray(problem.node_weights),
-            jnp.asarray(problem.valid_node),
-            jnp.asarray(problem.stickiness),
-            jnp.asarray(problem.gids),
-            jnp.asarray(problem.gid_valid),
+            jnp.asarray(prev_a),
+            jnp.asarray(pw_a),
+            jnp.asarray(nw_a),
+            jnp.asarray(valid_a),
+            jnp.asarray(stick_a),
+            jnp.asarray(gids_a),
+            jnp.asarray(gv_a),
             constraints,
             rules,
             max_iterations=max(int(opts.max_iterations), 1),
-            mode=resolve_default_fused_score(problem.P, problem.N),
+            mode=resolve_default_fused_score(solve_p, solve_n),
             allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
             context="plan_next_map_tpu",
             timer=timer,
+            # Only under bucketing: p_real keeps the fill denominator at
+            # the REAL partition count while sizes drift within a
+            # bucket.  Unbucketed solves keep total_p as a compile-time
+            # constant — a traced scalar changes how XLA
+            # strength-reduces the fill division, and those low bits
+            # flip jitter-level ties, perturbing the pinned fuzz
+            # contract for zero benefit on the default path.  (This is
+            # also why bucketed output is contract-equivalent to the
+            # unbucketed solve, not bit-identical.)
+            p_real=(np.float32(problem.P) if opts.shape_bucketing
+                    else None),
         )
+    assign = assign[:problem.P]  # bucketing pad rows are not real work
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")
     with phase_span("plan.decode", timer=timer):
