@@ -351,6 +351,75 @@ def bench_pipeline(P=256, N=32):
             "progress_events": events}
 
 
+def bench_chaos(P=96, N=12, seed=7, fail_rate=0.3):
+    """Chaos stage: transition completion under a fixed injected fault
+    rate (ISSUE 3).  A seeded FaultPlan makes one node dead and two
+    flaky at ``fail_rate``; the fault-tolerant rebalance (deadlines +
+    retries + quarantine + bounded recovery replans) must still land a
+    complete map on the surviving nodes.  Reports wall-clock, retry/
+    timeout/quarantine counters, recovery rounds, and whether the final
+    reconstructed map is whole — the robustness headline."""
+    from blance_tpu import Partition, model
+    from blance_tpu.obs import Recorder, use_recorder
+    from blance_tpu.orchestrate import FaultPlan, NodeFaults
+    from blance_tpu.orchestrate.orchestrator import OrchestratorOptions
+    from blance_tpu.rebalance import rebalance
+
+    nodes = [f"n{i:03d}" for i in range(N)]
+    live = nodes[:-1]
+    dead = nodes[-1]
+    m = model(primary=(0, 1), replica=(1, 1))
+    beg = {
+        f"{i:04d}": Partition(f"{i:04d}", {
+            "primary": [live[i % len(live)]],
+            "replica": [live[(i + 1) % len(live)]]})
+        for i in range(P)
+    }
+    plan = FaultPlan(seed=seed, nodes={
+        dead: NodeFaults(dead=True),
+        nodes[0]: NodeFaults(fail_rate=fail_rate),
+        nodes[1]: NodeFaults(fail_rate=fail_rate),
+    })
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        import asyncio
+
+        await asyncio.sleep(0)
+
+    rec = Recorder()
+    t0 = time.perf_counter()
+    with use_recorder(rec):
+        result = rebalance(
+            m, beg, nodes, [], [dead], plan.wrap(assign),
+            orchestrator_options=OrchestratorOptions(
+                move_timeout_s=0.25, max_retries=4, backoff_base_s=0.002,
+                quarantine_after=3, probe_after_s=60.0),
+            max_recovery_rounds=3, backend="greedy")
+    total_ms = (time.perf_counter() - t0) * 1000
+
+    complete = all(
+        len(p.nodes_by_state.get("primary", [])) == 1
+        and len(p.nodes_by_state.get("replica", [])) == 1
+        for p in result.achieved_map.values())
+    out = {
+        "P": P, "N": N, "seed": seed, "fail_rate": fail_rate,
+        "total_ms": round(total_ms, 1),
+        "complete": complete,
+        "failures": len(result.failures),
+        "recovery_rounds": len(result.rounds) - 1,
+        "quarantined": result.quarantined_nodes,
+        "injected": dict(plan.injected),
+        "retries": rec.counters.get("orchestrate.retries", 0),
+        "timeouts": rec.counters.get("orchestrate.timeouts", 0),
+        "quarantine_trips": rec.counters.get(
+            "orchestrate.quarantine_trips", 0),
+    }
+    log(f"[chaos {P}x{N}] complete={complete} failures={out['failures']} "
+        f"retries={out['retries']:.0f} trips={out['quarantine_trips']:.0f} "
+        f"recovery_rounds={out['recovery_rounds']} in {total_ms:.0f}ms")
+    return out
+
+
 def bench_delta_replan(P, N):
     """Cold vs warm delta replan through PlannerSession: the
     incremental-replanning headline (ISSUE 2).
@@ -795,6 +864,15 @@ def _run_benchmarks(smoke, backend_note=None):
         log(f"pipeline stage failed ({type(e).__name__}: {first_line(e)})")
         detail["pipeline_error"] = first_line(e)
     save_progress(detail, "pipeline done")
+
+    # Chaos stage: transition completion under a fixed injected fault
+    # rate — retries + quarantine + recovery replans end-to-end.
+    try:
+        detail["chaos"] = bench_chaos()
+    except Exception as e:  # must not eat the solve numbers
+        log(f"chaos stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["chaos_error"] = first_line(e)
+    save_progress(detail, "chaos done")
 
     # Delta-replan stage: the incremental (warm-carry) replan against a
     # cold solve of the identical delta — cold vs warm sweeps and

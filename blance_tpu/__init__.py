@@ -37,6 +37,7 @@ from .plan.api import plan_next_map, plan_next_map_legacy
 from .plan.session import PlannerSession
 from .rebalance import (
     RebalanceResult,
+    RecoveryRound,
     load_partition_map,
     rebalance,
     rebalance_async,
@@ -76,6 +77,7 @@ __all__ = [
     "plan_next_map_greedy",
     "plan_next_map_legacy",
     "RebalanceResult",
+    "RecoveryRound",
     "load_partition_map",
     "rebalance",
     "rebalance_async",

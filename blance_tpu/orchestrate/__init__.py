@@ -1,11 +1,17 @@
 """blance_tpu.orchestrate — asyncio rebalance control plane."""
 
 from .csp import GET, PUT, Chan, ChanClosed, select
+from .faults import FaultInjected, FaultPlan, NodeFaults
+from .health import HALF_OPEN, HEALTHY, QUARANTINED, HealthTracker, NodeHealth
 from .orchestrator import (
     MOVE_OP_WEIGHT,
     ErrorInterrupt,
     ErrorStopped,
+    MissingMoverError,
+    MoveFailure,
+    MoveTimeoutError,
     NextMoves,
+    NodeQuarantinedError,
     Orchestrator,
     OrchestratorOptions,
     OrchestratorProgress,
@@ -20,10 +26,22 @@ __all__ = [
     "Chan",
     "ChanClosed",
     "select",
+    "FaultInjected",
+    "FaultPlan",
+    "NodeFaults",
+    "HEALTHY",
+    "QUARANTINED",
+    "HALF_OPEN",
+    "HealthTracker",
+    "NodeHealth",
     "MOVE_OP_WEIGHT",
     "ErrorInterrupt",
     "ErrorStopped",
+    "MissingMoverError",
+    "MoveFailure",
+    "MoveTimeoutError",
     "NextMoves",
+    "NodeQuarantinedError",
     "Orchestrator",
     "OrchestratorOptions",
     "OrchestratorProgress",

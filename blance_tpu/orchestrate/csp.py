@@ -77,6 +77,14 @@ class Chan:
     def _try_get(self) -> Optional[tuple[Any, bool]]:
         while self._putters:
             waiter, item = self._putters.popleft()
+            # A done future here means the waiter was abandoned (its
+            # awaiting task cancelled, e.g. an aborted timed wait) or its
+            # select already committed elsewhere: skip it — resolving it
+            # would raise InvalidStateError, and treating a cancelled
+            # putter's item as delivered would lose the rendezvous
+            # guarantee.
+            if waiter.future.done():
+                continue
             if waiter.token.claim():
                 waiter.future.set_result((waiter.index, None))
                 return (item, True)
@@ -89,6 +97,8 @@ class Chan:
             raise ChanClosed()
         while self._getters:
             waiter = self._getters.popleft()
+            if waiter.future.done():  # abandoned/committed — see _try_get
+                continue
             if waiter.token.claim():
                 waiter.future.set_result((waiter.index, (item, True)))
                 return True
@@ -103,10 +113,14 @@ class Chan:
         self._putters.append((waiter, item))
 
     def _gc(self) -> None:
-        """Drop claimed waiters so deques don't grow across selects."""
-        self._getters = deque(w for w in self._getters if not w.token.claimed)
+        """Drop claimed AND abandoned (cancelled-future) waiters so
+        deques don't grow across selects or expired timed waits."""
+        self._getters = deque(
+            w for w in self._getters
+            if not w.token.claimed and not w.future.done())
         self._putters = deque(
-            (w, i) for (w, i) in self._putters if not w.token.claimed
+            (w, i) for (w, i) in self._putters
+            if not w.token.claimed and not w.future.done()
         )
 
     # -- blocking ops --------------------------------------------------------
@@ -132,16 +146,21 @@ class Chan:
             raise err
 
     def close(self) -> None:
-        """Idempotent close; wakes all pending getters/putters."""
+        """Idempotent close; wakes all pending getters/putters (skipping
+        abandoned waiters whose futures were cancelled)."""
         if self._closed:
             return
         self._closed = True
         while self._getters:
             waiter = self._getters.popleft()
+            if waiter.future.done():
+                continue
             if waiter.token.claim():
                 waiter.future.set_result((waiter.index, (None, False)))
         while self._putters:
             waiter, _ = self._putters.popleft()
+            if waiter.future.done():
+                continue
             if waiter.token.claim():
                 waiter.future.set_result((waiter.index, ChanClosed()))
 

@@ -1,0 +1,141 @@
+"""Per-node health tracking: a circuit breaker for the orchestrator.
+
+The reference orchestrator has no notion of node health — a node whose
+assign callback keeps failing is fed moves forever (each one burning the
+app's retry budget), and a dead node wedges the transition.  This module
+adds the classic three-state breaker, per node:
+
+    healthy ──(N consecutive failures)──> quarantined
+    quarantined ──(probe_after_s elapsed)──> half-open
+    half-open ──(probe succeeds)──> healthy
+    half-open ──(probe fails)──> quarantined   (timer restarts)
+
+While quarantined, the mover releases queued batches for the node
+immediately as failures (``Orchestrator`` turns them into structured
+``MoveFailure``s) instead of invoking the callback — so a dead node's
+work drains fast and the failure-aware recovery replan
+(``rebalance_async``) can re-place it on live nodes.  After
+``probe_after_s`` the breaker admits exactly ONE probe batch at a time;
+a success re-admits the node, a failure re-trips it.
+
+Wall-clock enters only through the injectable ``clock`` callable
+(default ``time.monotonic``), so tier-1 tests drive the breaker through
+its whole state machine in virtual time, deterministically.
+
+Every trip bumps the ``orchestrate.quarantine_trips`` counter on the
+obs Recorder (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..obs import get_recorder
+
+__all__ = ["HEALTHY", "QUARANTINED", "HALF_OPEN", "NodeHealth",
+           "HealthTracker"]
+
+HEALTHY = "healthy"
+QUARANTINED = "quarantined"
+HALF_OPEN = "half-open"
+
+
+@dataclass
+class NodeHealth:
+    """Mutable breaker state for one node."""
+
+    state: str = HEALTHY
+    consecutive_failures: int = 0
+    trips: int = 0  # lifetime quarantine entries
+    tripped_at: float = 0.0  # clock() of the last trip
+    probe_in_flight: bool = False
+
+
+@dataclass
+class HealthTracker:
+    """Circuit breaker over a set of nodes.
+
+    threshold: consecutive failures (or timeouts) that trip quarantine.
+    probe_after_s: quarantine dwell before the first half-open probe.
+    clock: monotonic-seconds source; injectable for virtual-time tests.
+    """
+
+    threshold: int = 3
+    probe_after_s: float = 1.0
+    clock: Callable[[], float] = time.monotonic
+    _nodes: dict = field(default_factory=dict)
+
+    def _get(self, node: str) -> NodeHealth:
+        h = self._nodes.get(node)
+        if h is None:
+            h = self._nodes[node] = NodeHealth()
+        return h
+
+    # -- outcome reporting ---------------------------------------------------
+
+    def record_success(self, node: str) -> None:
+        """A callback attempt for ``node`` succeeded: half-open heals,
+        failure streaks reset."""
+        h = self._get(node)
+        h.consecutive_failures = 0
+        h.probe_in_flight = False
+        h.state = HEALTHY
+
+    def record_failure(self, node: str) -> bool:
+        """A callback attempt for ``node`` failed or timed out.  Returns
+        True when THIS failure tripped the node into quarantine (a
+        half-open probe failure re-trips and also returns True)."""
+        h = self._get(node)
+        h.consecutive_failures += 1
+        was_open = h.state in (QUARANTINED, HALF_OPEN)
+        if h.state == HALF_OPEN:
+            h.probe_in_flight = False
+            tripped = True
+        else:
+            tripped = h.state == HEALTHY and \
+                h.consecutive_failures >= max(self.threshold, 1)
+        if tripped:
+            h.state = QUARANTINED
+            h.tripped_at = self.clock()
+            h.trips += 1
+            get_recorder().count("orchestrate.quarantine_trips")
+        elif was_open:
+            # Failure while quarantined without an admitted probe (e.g. a
+            # retry already in flight when the trip happened): stay put,
+            # keep the original dwell timer.
+            h.state = QUARANTINED
+        return tripped
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, node: str) -> str:
+        """Gate one batch for ``node``: "ok" (healthy), "probe" (half-open
+        trial admission — exactly one at a time), or "reject" (quarantined:
+        release the batch as a failure without calling the app)."""
+        h = self._nodes.get(node)
+        if h is None or h.state == HEALTHY:
+            return "ok"
+        if h.state == QUARANTINED and \
+                self.clock() - h.tripped_at >= self.probe_after_s:
+            h.state = HALF_OPEN
+        if h.state == HALF_OPEN and not h.probe_in_flight:
+            h.probe_in_flight = True
+            return "probe"
+        return "reject"
+
+    # -- introspection -------------------------------------------------------
+
+    def state(self, node: str) -> str:
+        h = self._nodes.get(node)
+        return h.state if h is not None else HEALTHY
+
+    def quarantined_nodes(self) -> list[str]:
+        """Nodes currently tripped (quarantined or half-open), sorted —
+        the set the recovery replan treats as ``nodes_to_remove``."""
+        return sorted(n for n, h in self._nodes.items()
+                      if h.state in (QUARANTINED, HALF_OPEN))
+
+    def total_trips(self) -> int:
+        return sum(h.trips for h in self._nodes.values())

@@ -22,19 +22,26 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import random
 import time
+import warnings as _warnings
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional, Union
 
-from ..core.types import PartitionMap, PartitionModel
+from ..core.types import Partition, PartitionMap, PartitionModel
 from ..moves.calc import calc_partition_moves
 from ..obs import get_recorder
 from ..plan.greedy import sort_state_names
 from .csp import Chan, select, GET, PUT
+from .health import HealthTracker
 
 __all__ = [
     "ErrorStopped",
     "ErrorInterrupt",
+    "MissingMoverError",
+    "MoveFailure",
+    "MoveTimeoutError",
+    "NodeQuarantinedError",
     "Orchestrator",
     "OrchestratorOptions",
     "OrchestratorProgress",
@@ -59,6 +66,60 @@ ErrorStopped = StoppedError("stopped")
 ErrorInterrupt = InterruptError("interrupt")
 
 
+class MoveTimeoutError(Exception):
+    """An assign callback exceeded OrchestratorOptions.move_timeout_s."""
+
+    def __init__(self, node: str, timeout_s: float) -> None:
+        super().__init__(f"assign_partitions for node {node!r} exceeded "
+                         f"move deadline {timeout_s}s")
+        self.node = node
+        self.timeout_s = timeout_s
+
+
+class NodeQuarantinedError(Exception):
+    """A batch was released unexecuted: its node is quarantined."""
+
+    def __init__(self, node: str) -> None:
+        super().__init__(f"node {node!r} is quarantined")
+        self.node = node
+
+
+class MissingMoverError(Exception):
+    """A move targets a node outside nodes_all — no mover will ever
+    serve it (reference orchestrate.go:667 nil-channel semantics)."""
+
+    def __init__(self, node: str) -> None:
+        super().__init__(f"move targets node {node!r} which has no mover "
+                         f"(not in nodes_all)")
+        self.node = node
+
+
+@dataclass(eq=False)  # exception identity semantics; stays hashable
+class MoveFailure(Exception):
+    """One partition move that fault-tolerant orchestration gave up on.
+
+    Replaces the bare exception of the legacy path when the options
+    enable deadlines/retries/quarantine: carries exactly which (node,
+    partition, state, op) failed, how many attempts were burned, and the
+    last underlying cause (app exception, MoveTimeoutError,
+    NodeQuarantinedError, or MissingMoverError).  Flows through
+    progress.errors and ``Orchestrator.move_failures()``; the recovery
+    replan (rebalance_async) consumes it."""
+
+    node: str
+    partition: str
+    state: str
+    op: str
+    attempts: int
+    cause: object
+
+    def __post_init__(self) -> None:
+        Exception.__init__(
+            self, f"move failed: partition={self.partition!r} "
+            f"node={self.node!r} state={self.state!r} op={self.op!r} "
+            f"attempts={self.attempts} cause={self.cause!r}")
+
+
 @dataclass
 class OrchestratorOptions:
     """Advanced config (orchestrate.go:110-115 + scale extensions)."""
@@ -66,6 +127,42 @@ class OrchestratorOptions:
     # <= 0 is treated as 1 (orchestrate.go:484-487).
     max_concurrent_partition_moves_per_node: int = 1
     favor_min_nodes: bool = False
+
+    # -- fault-tolerance extensions (not in the reference; ALL unset =>
+    #    the reference's exact failure semantics: an app error aborts the
+    #    orchestration, a hung callback stalls its mover, a moverless
+    #    target blocks until stop).  Setting any of them turns a
+    #    timed-out or retry-exhausted move into a structured MoveFailure
+    #    recorded in progress.errors, and the orchestration continues
+    #    with the remaining partitions. --
+    # Per-move deadline for ASYNC assign callbacks (a sync callback
+    # blocks the loop and cannot be preempted); a breach counts as a
+    # failed attempt with a MoveTimeoutError cause.
+    move_timeout_s: Optional[float] = None
+    # Failed attempts are retried up to this many times with exponential
+    # backoff: base * 2^attempt * (1 + jitter * u), u drawn from a
+    # Random(retry_seed) so schedules are reproducible.
+    max_retries: int = 0
+    backoff_base_s: float = 0.05
+    backoff_jitter: float = 0.25
+    retry_seed: int = 0
+    # Circuit breaker: this many CONSECUTIVE failed attempts quarantine a
+    # node (0 disables).  Queued batches for a quarantined node are
+    # released immediately as MoveFailures; after probe_after_s one probe
+    # batch at a time is admitted and a success re-opens the node
+    # (orchestrate/health.py).
+    quarantine_after: int = 0
+    probe_after_s: float = 1.0
+    # Externally-owned HealthTracker (e.g. carried across the recovery
+    # rounds of one rebalance); when set, quarantine_after/probe_after_s
+    # are ignored in favor of the tracker's own thresholds.
+    health: Optional[HealthTracker] = None
+
+    @property
+    def fault_tolerant(self) -> bool:
+        """True when any fault-tolerance option deviates from defaults."""
+        return (self.move_timeout_s is not None or self.max_retries > 0
+                or self.quarantine_after > 0 or self.health is not None)
 
     # -- scale extensions (not in the reference) --
     # True (reference semantics, orchestrate.go:566-580): the first
@@ -109,6 +206,13 @@ class OrchestratorProgress:
     tot_run_supply_moves_resume: int = 0
     tot_progress_close: int = 0
 
+    # -- fault-tolerance counters (always 0 in legacy mode) --
+    tot_mover_assign_partition_retry: int = 0
+    tot_mover_assign_partition_timeout: int = 0
+    tot_mover_quarantine_reject: int = 0
+    tot_quarantine_trips: int = 0
+    tot_move_failures: int = 0
+
     def snapshot(self) -> "OrchestratorProgress":
         # One snapshot per progress event: a shallow __dict__ copy is
         # ~4x cheaper than dataclasses.replace (which re-runs __init__
@@ -151,7 +255,7 @@ class NextMoves:
     """Cursor over one partition's immutable move sequence
     (orchestrate.go:198-214)."""
 
-    __slots__ = ("partition", "next", "moves", "next_done_ch")
+    __slots__ = ("partition", "next", "moves", "next_done_ch", "failed_at")
 
     def __init__(self, partition: str, moves: list) -> None:
         self.partition = partition
@@ -160,6 +264,11 @@ class NextMoves:
         # Non-None while the current move is in flight; == the feeding
         # request's done channel.
         self.next_done_ch: Optional[Chan] = None
+        # Fault-tolerant mode: index of the move that failed when this
+        # partition was abandoned (its remaining moves are skipped;
+        # ``next`` jumps to len(moves) so availability drops it).  None
+        # while healthy — and always None in legacy mode.
+        self.failed_at: Optional[int] = None
 
 
 class _PartitionMoveReq:
@@ -219,6 +328,20 @@ class Orchestrator:
         # was installed when it started.
         self._rec = get_recorder()
 
+        # -- fault tolerance (all inert when options keep the defaults) --
+        self._ft = options.fault_tolerant
+        self.failures: list[MoveFailure] = []
+        if options.health is not None:
+            self.health: Optional[HealthTracker] = options.health
+        elif options.quarantine_after > 0:
+            self.health = HealthTracker(
+                threshold=options.quarantine_after,
+                probe_after_s=options.probe_after_s)
+        else:
+            self.health = None
+        self._retry_rng = random.Random(options.retry_seed)
+        self._missing_mover_warned: set[str] = set()
+
     # -- public control surface ---------------------------------------------
 
     def progress_ch(self) -> Chan:
@@ -253,6 +376,37 @@ class Orchestrator:
         """Read access to the live move cursors, e.g. for UIs
         (orchestrate.go:395-399)."""
         cb(self._map_partition_to_next_moves)
+
+    def move_failures(self) -> list[MoveFailure]:
+        """Structured failures collected so far (fault-tolerant mode
+        only; legacy mode aborts on the first error instead).  Complete
+        once progress_ch() has closed."""
+        return list(self.failures)
+
+    def achieved_map(self) -> PartitionMap:
+        """Reconstruct the map the cluster actually reached: beg_map with
+        every SUCCESSFULLY executed move applied, per partition, up to
+        its cursor (an abandoned partition counts its moves up to the
+        one that failed — a failed batch is assumed not applied).
+
+        This is the honest ``current_map`` for a failure-aware recovery
+        replan; call after progress_ch() closes (mid-run it reflects the
+        in-flight frontier, which is fine for dashboards but racy as a
+        replan input)."""
+        achieved: PartitionMap = {}
+        for name, beg in self.beg_map.items():
+            nbs = {s: list(ns) for s, ns in beg.nodes_by_state.items()}
+            nm = self._map_partition_to_next_moves.get(name)
+            upto = 0 if nm is None else (
+                nm.failed_at if nm.failed_at is not None else nm.next)
+            for mv in (nm.moves[:upto] if nm is not None else ()):
+                for ns in nbs.values():
+                    if mv.node in ns:
+                        ns.remove(mv.node)
+                if mv.state:  # "" = removal (the "del" op)
+                    nbs.setdefault(mv.state, []).append(mv.node)
+            achieved[name] = Partition(name, nbs)
+        return achieved
 
     # -- internals -----------------------------------------------------------
 
@@ -289,14 +443,92 @@ class Orchestrator:
 
     async def _call_assign(self, stop_ch, node, partitions, states, ops):
         """Invoke the app callback (sync or async); exceptions become the
-        move's error."""
+        move's error.  With ``move_timeout_s`` set, an ASYNC callback
+        that outlives the deadline is cancelled and the attempt fails
+        with MoveTimeoutError (sync callbacks block the loop and cannot
+        be preempted — use an async data plane for deadlines)."""
+        timeout_s = self.options.move_timeout_s
         try:
             result = self._assign_partitions(stop_ch, node, partitions, states, ops)
             if inspect.isawaitable(result):
-                result = await result
+                if timeout_s is not None:
+                    # The TimeoutError handler is scoped to wait_for ONLY,
+                    # and a deadline breach is distinguished from the app
+                    # RAISING TimeoutError itself (on 3.11+
+                    # asyncio.TimeoutError IS builtin TimeoutError, e.g. a
+                    # socket timeout) by whether wait_for cancelled the
+                    # callback: only a breach does.  An app-raised timeout
+                    # flows through as the app's error, never rebranded.
+                    fut = asyncio.ensure_future(result)
+                    try:
+                        result = await asyncio.wait_for(fut, timeout_s)
+                    except asyncio.TimeoutError as exc:
+                        if not fut.cancelled():
+                            return exc  # the app's own TimeoutError
+                        self._rec.count("orchestrate.timeouts")
+                        self._bump_sync("tot_mover_assign_partition_timeout")
+                        return MoveTimeoutError(node, timeout_s)
+                else:
+                    result = await result
         except Exception as exc:  # app errors flow into progress.errors
             return exc
         return result if isinstance(result, Exception) else None
+
+    async def _wait_or_stop(self, stop_ch: Chan, delay_s: float) -> bool:
+        """Sleep ``delay_s``, aborting early when stop fires; True means
+        the orchestration was stopped.  Backoff must never outlive
+        stop(): a 30 s retry backoff on a dead node would otherwise hold
+        the whole wind-down hostage."""
+        if stop_ch.closed:
+            return True
+        getter = asyncio.ensure_future(stop_ch.get())
+        done, _pending = await asyncio.wait({getter}, timeout=delay_s)
+        if getter not in done:
+            # csp.Chan tolerates cancelled waiters: close() skips
+            # completed/cancelled futures instead of resolving them.
+            getter.cancel()
+            try:
+                await getter
+            except asyncio.CancelledError:
+                pass
+            # Eagerly drop the abandoned waiter: the stop channel is
+            # shared by every mover, and one dead getter per expired
+            # backoff would otherwise accumulate until close().
+            stop_ch._gc()
+        return stop_ch.closed
+
+    async def _exec_with_retries(self, stop_ch, node, partitions, states,
+                                 ops):
+        """One batch execution under the fault-tolerance policy: bounded
+        retries with exponential backoff + deterministic jitter, per-
+        attempt health reporting.  Returns (err, attempts); legacy mode
+        (no FT options) is exactly one _call_assign."""
+        opts = self.options
+        max_attempts = 1 + (max(opts.max_retries, 0) if self._ft else 0)
+        attempt = 0
+        while True:
+            attempt += 1
+            err = await self._call_assign(stop_ch, node, partitions,
+                                          states, ops)
+            if err is None:
+                if self.health is not None:
+                    self.health.record_success(node)
+                return None, attempt
+            tripped = False
+            if self.health is not None:
+                tripped = self.health.record_failure(node)
+                if tripped:
+                    self._bump_sync("tot_quarantine_trips")
+            if not self._ft or attempt >= max_attempts or tripped:
+                return err, attempt
+            delay = opts.backoff_base_s * (2.0 ** (attempt - 1))
+            delay *= 1.0 + max(opts.backoff_jitter, 0.0) * \
+                self._retry_rng.random()
+            self._rec.count("orchestrate.retries")
+            self._rec.observe("orchestrate.retry_backoff_s", delay)
+            await self._bump("tot_mover_assign_partition_retry")
+            if await self._wait_or_stop(stop_ch, delay):
+                return err, attempt
 
     async def _run_mover(self, stop_ch: Chan, done_ch: Chan, node: str) -> None:
         await self._bump("tot_run_mover")
@@ -331,6 +563,15 @@ class Orchestrator:
             states = [pm.state for pm in req.partition_moves]
             ops = [pm.op for pm in req.partition_moves]
 
+            # Circuit breaker: a quarantined node's queued batches are
+            # released immediately as failures — no callback, no retry
+            # budget — so a dead node's work drains instead of wedging.
+            # A half-open probe admission executes normally; its outcome
+            # heals or re-trips the node (orchestrate/health.py).
+            admit = "ok"
+            if self.health is not None:
+                admit = self.health.admit(node)
+
             lane = f"mover:{node}"
             with self._rec.span(
                     "orchestrate.move", t_start=req.t_created, task=lane,
@@ -339,34 +580,70 @@ class Orchestrator:
                     "orchestrate.move.wait", req.t_created, t_recv,
                     task=lane, node=node)
 
-                await self._bump("tot_mover_assign_partition")
+                if admit == "reject":
+                    await self._bump("tot_mover_quarantine_reject")
+                    err, attempts = NodeQuarantinedError(node), 0
+                    mv.attrs["quarantined"] = True
+                    mv.attrs["ok"] = False
+                else:
+                    await self._bump("tot_mover_assign_partition")
 
-                t_exec = time.perf_counter()
-                with self._rec.span("orchestrate.move.exec", task=lane,
-                                    node=node, ops=",".join(ops)):
-                    err = await self._call_assign(
-                        stop_ch, node, partitions, states, ops)
-                exec_s = time.perf_counter() - t_exec
-                mv.attrs["wait_s"] = t_recv - req.t_created
-                mv.attrs["exec_s"] = exec_s
-                mv.attrs["ok"] = err is None
-                # One observation per partition move, with the batch's
-                # callback time amortized across its moves — so the
-                # histogram's sum equals real exec wall-clock, not
-                # batch-size-weighted batch latency.
-                per_move_s = exec_s / max(len(req.partition_moves), 1)
-                for _ in req.partition_moves:
-                    self._rec.observe("orchestrate.move_latency_s",
-                                      per_move_s)
+                    t_exec = time.perf_counter()
+                    with self._rec.span("orchestrate.move.exec", task=lane,
+                                        node=node, ops=",".join(ops)):
+                        err, attempts = await self._exec_with_retries(
+                            stop_ch, node, partitions, states, ops)
+                    exec_s = time.perf_counter() - t_exec
+                    mv.attrs["wait_s"] = t_recv - req.t_created
+                    mv.attrs["exec_s"] = exec_s
+                    mv.attrs["ok"] = err is None
+                    if attempts > 1:
+                        mv.attrs["attempts"] = attempts
+                    # One observation per partition move, with the batch's
+                    # callback time amortized across its moves — so the
+                    # histogram's sum equals real exec wall-clock, not
+                    # batch-size-weighted batch latency.
+                    per_move_s = exec_s / max(len(req.partition_moves), 1)
+                    for _ in req.partition_moves:
+                        self._rec.observe("orchestrate.move_latency_s",
+                                          per_move_s)
 
-                await self._bump(
-                    "tot_mover_assign_partition_err" if err is not None
-                    else "tot_mover_assign_partition_ok")
+                    await self._bump(
+                        "tot_mover_assign_partition_err" if err is not None
+                        else "tot_mover_assign_partition_ok")
+
+            if err is not None and self._ft:
+                # Structured failure per partition move in the batch; the
+                # first one rides the done channel so waiting feeders can
+                # abandon their cursors without aborting the round loop.
+                err = await self._record_batch_failure(
+                    node, req.partition_moves, attempts, err)
 
             if req.done_ch is not None:
                 if err is not None:
                     await select((GET, stop_ch), (PUT, req.done_ch, err))
                 req.done_ch.close()
+
+    async def _record_batch_failure(self, node, partition_moves, attempts,
+                                    cause) -> MoveFailure:
+        """Fold one failed batch into the structured failure history:
+        one MoveFailure per partition move, appended to ``failures`` AND
+        ``progress.errors`` (snapshot emitted once for the batch).
+        Returns the first failure, the batch's representative error."""
+        batch = [
+            MoveFailure(node=node, partition=pm.partition, state=pm.state,
+                        op=pm.op, attempts=attempts, cause=cause)
+            for pm in partition_moves
+        ]
+        self.failures.extend(batch)
+
+        def record():
+            for f in batch:
+                self._progress.errors.append(f)
+                self._bump_sync("tot_move_failures")
+                self._rec.count("orchestrate.move_failures")
+        await self._update_progress(record)
+        return batch[0]
 
     def _filter_next_plausible_moves_for_node(
         self, node: str, next_moves_arr: list[NextMoves]
@@ -476,6 +753,16 @@ class Orchestrator:
                 if err is None and interrupt and not broadcast_stopped:
                     broadcast_stop_ch.close()
                     broadcast_stopped = True
+                if isinstance(err, MoveFailure) and self._ft:
+                    # Already recorded in progress.errors/failures; the
+                    # partition was abandoned.  NOT fatal: the remaining
+                    # partitions keep moving (legacy mode instead aborts
+                    # on the first error, below).  A completed feed — even
+                    # a failed one — still refreshes availability.
+                    if interrupt and not broadcast_stopped:
+                        broadcast_stop_ch.close()
+                        broadcast_stopped = True
+                    continue
                 if err is not None and err is not ErrorInterrupt and err_outer is None:
                     err_outer = err
 
@@ -539,9 +826,21 @@ class Orchestrator:
             # reference sends on a nil channel there, which blocks until the
             # stop/broadcast branch fires (orchestrate.go:667 with a missing
             # map key) — the move simply stalls, it does not error.  A fresh
-            # never-received Chan reproduces that.
+            # never-received Chan reproduces that.  Either way the stall is
+            # SURFACED now: a counter bump plus a one-time warning naming
+            # the node; with a move deadline set the move fails fast as a
+            # MoveFailure instead of silently wedging.
             req_ch = self._map_node_to_req_ch.get(node)
             if req_ch is None:
+                self._note_missing_mover(node)
+                if self._ft and self.options.move_timeout_s is not None:
+                    first = await self._record_batch_failure(
+                        node, req.partition_moves, 0, MissingMoverError(node))
+                    for nm in next_moves:
+                        nm.failed_at = nm.next
+                        nm.next = len(nm.moves)
+                    await broadcast_done_ch.put(first)
+                    return
                 req_ch = Chan()
             which, _ = await select(
                 (GET, stop_ch),
@@ -572,8 +871,30 @@ class Orchestrator:
             for nm in next_moves:
                 if nm.next_done_ch is next_done_ch:
                     nm.next_done_ch = None
-                    nm.next += 1
+                    if isinstance(err, MoveFailure):
+                        # Fault-tolerant abandon: skip this partition's
+                        # remaining moves (executing e.g. the "del" after
+                        # a failed "add" would corrupt coverage); the
+                        # recovery replan re-places it.
+                        nm.failed_at = nm.next
+                        nm.next = len(nm.moves)
+                    else:
+                        nm.next += 1
             await broadcast_done_ch.put(err)
+
+    def _note_missing_mover(self, node: str) -> None:
+        """Surface the reference's silent moverless-node stall: bump
+        ``orchestrate.missing_mover`` every time, warn once per node."""
+        self._rec.count("orchestrate.missing_mover")
+        if node not in self._missing_mover_warned:
+            self._missing_mover_warned.add(node)
+            _warnings.warn(
+                f"blance_tpu orchestrate: move targets node {node!r} which "
+                f"has no mover (not in nodes_all); the move "
+                + ("fails fast (move deadline set)"
+                   if self._ft and self.options.move_timeout_s is not None
+                   else "stalls until stop (reference semantics)"),
+                UserWarning, stacklevel=2)
 
     async def _wait_for_all_movers_done(self, run_mover_done_ch: Chan) -> None:
         """Collect every mover's exit, folding errors into progress

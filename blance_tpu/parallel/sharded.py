@@ -225,6 +225,12 @@ def solve_dense_sharded(
     # monkeypatch-visible (tests patch tensor-module attributes).
     from ..plan import tensor as _tensor
 
+    # The per-shard solves run under shard_map (traced), where the tier-
+    # band scale guard must skip — assert it here on the concrete host
+    # values instead, once for the whole mesh.
+    _tensor._check_tier_band_scale(
+        prev, pweights, nweights, valid, stickiness, constraints, rules)
+
     # Resolve against the PER-SHARD slice: each device holds P/n_shards
     # rows (x N/node_shards columns) of every [P, N] intermediate, so
     # that is the working set the chip must fit.  None = follow the

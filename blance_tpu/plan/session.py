@@ -539,6 +539,20 @@ class PlannerSession:
             return False
         return any(check_assignment(prob, assign).values())
 
+    def recovery_replan(self, dead_nodes: list[str]) -> np.ndarray:
+        """Failure-aware re-entry (rebalance_async recovery rounds):
+        drain ``dead_nodes`` — nodes the orchestrator quarantined mid-
+        transition — and replan.  ``remove_nodes`` marks exactly the
+        partitions holding a copy on a dead node dirty, so when the
+        session's carry is live (the failed pass's proposal was adopted
+        and its failures were confined to the dead nodes) this replan is
+        the one-sweep warm repair rather than a cold fixpoint, falling
+        back to cold under the usual gates.  Returns the proposed
+        assignment; materialize with ``to_map("proposed")`` and adopt
+        with ``apply()`` once the recovery transition lands."""
+        self.remove_nodes(list(dead_nodes))
+        return self.replan()
+
     def moves(
         self, favor_min_nodes: bool = False
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -66,6 +66,23 @@ __all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
 _RULE_TIER = 1.0e4  # penalty step per rule index (earlier rules win)
+# SCALE ASSUMPTION (round-5 advisor finding): tier equality is decided by
+# BAND tests — "same tier" means the raw score sits within _RULE_TIER/2 of
+# the row's unpriced minimum (see rule_ok/soft_ok in _assign_slot and the
+# pin pass's floor test).  That is only sound while every within-tier
+# score term stays well below the band: the seeded per-node fill term
+# (≈ sum(constraints) * total_weight / total_node_weight for balanced
+# prevs, or max(seed_fill/node_weight) for skewed ones), stickiness, and
+# the negative-weight boost.  At extreme P/N ratios (≳2k unit-weight
+# partitions per node per slot) the fill term alone crosses the band and
+# nodes stop being tier-comparable — placements would silently
+# misclassify tiers.  _check_tier_band_scale below asserts the headroom
+# at every host-side solve entry so such problems fail loudly instead.
+_TIER_BAND_HEADROOM = 0.45  # max allowed within-tier mass, in tiers
+# Passed-check memo for _check_tier_band_scale: (array id + shape +
+# statics) -> weight fingerprint.  See the function for the safety
+# argument; bounded at 256 entries.
+_tier_scale_memo: dict = {}
 _MAX_AUCTION_ROUNDS = 16
 # Bid-spreading jitter: above the advisory fill factor (0.001/P) by design,
 # below every decision-bearing term (stickiness >= 1.5 typical, rule tiers
@@ -1395,6 +1412,83 @@ def _record_sweeps(sweeps) -> None:
     rec.set_attr("sweeps", n)
 
 
+def _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
+                           constraints, rules) -> None:
+    """Assert the tier-equality band's scale assumption (see _RULE_TIER).
+
+    Estimates the largest within-tier score mass a node can carry —
+    the per-node fill term at its capacity rail AND at the prev map's
+    seeded skew, plus max stickiness and max negative-weight boost —
+    and raises ValueError when it eats into the _RULE_TIER/2 band
+    (headroom _TIER_BAND_HEADROOM).  Rule-less problems never consult
+    the band and are exempt.  Host-side only: silently skipped under a
+    jit/shard_map trace (the host entry already checked concrete
+    values).  Cost: one vectorized bincount over prev (a few ms at
+    the 100k-partition north star), memoized per (prev identity,
+    weight/stickiness fingerprint) so the steady-state warm-replan loop
+    — which passes the SAME adopted ``current`` array replan after
+    replan — pays it once, not per solve."""
+    if not any(rl for rl in rules):
+        return
+    from jax import core as _jax_core
+
+    args = (prev, pweights, nweights, valid, stickiness)
+    if any(isinstance(a, _jax_core.Tracer) for a in args):
+        return
+    prev_in = prev
+    prev = np.asarray(prev)
+    pw = np.asarray(pweights, np.float64)
+    nw = np.asarray(nweights, np.float64)
+    valid = np.asarray(valid, bool)
+    stick = np.asarray(stickiness, np.float64)
+    n = nw.shape[0]
+    if prev.size == 0 or n == 0:
+        return
+    # Memo key: array identity + cheap O(P+N) fingerprint.  The
+    # fingerprint (not identity alone) guards against id() reuse after
+    # gc and against in-place weight edits; a stale hit can only skip a
+    # re-check of an already-validated shape, never corrupt a solve.
+    key = (id(prev_in), prev.shape, n, tuple(constraints),
+           tuple(tuple(r) for r in rules))
+    fingerprint = (float(pw.sum()), float(stick.max()) if stick.size else 0.0,
+                   float(nw.min()), float(nw.max()), int(valid.sum()))
+    if _tier_scale_memo.get(key) == fingerprint:
+        return
+    total_w = float(pw.sum())
+    cap_w = np.where(valid & (nw >= 0), np.maximum(nw, 1.0), 0.0)
+    w_div = np.where(nw > 0, nw, 1.0)
+    # Balanced ceiling: every constrained slot's capacity rail lands
+    # ~K * total_w * share on a node; dividing by the node's weight
+    # cancels the share for uniform shares.
+    k_total = float(sum(max(int(c), 0) for c in constraints))
+    rail_term = k_total * total_w / max(float(cap_w.sum()), 1.0)
+    # Skewed seed: the prev map's actual per-node weighted fill
+    # (bincount, not add.at — vectorized, so the guard stays a few ms
+    # even at 100k partitions and never taxes the warm replan path).
+    ids = prev.reshape(prev.shape[0], -1)
+    w_rep = np.broadcast_to(pw[:, None], ids.shape)
+    m = ids >= 0
+    fill = np.bincount(ids[m].ravel(), weights=w_rep[m].ravel(),
+                       minlength=n)[:n]
+    seed_term = float((fill / w_div).max()) if n else 0.0
+    bound = max(rail_term, seed_term)
+    bound += float(stick.max()) if stick.size else 0.0
+    bound += float(np.maximum(-nw, 0.0).max())
+    if bound >= _TIER_BAND_HEADROOM * _RULE_TIER:
+        raise ValueError(
+            f"hierarchy tier band overflow: within-tier score mass "
+            f"~{bound:.0f} >= {_TIER_BAND_HEADROOM:.2f} * _RULE_TIER "
+            f"({_RULE_TIER:.0f}) — at this partitions-per-node scale "
+            f"(P={prev.shape[0]}, usable N={int(cap_w.nonzero()[0].size)}, "
+            f"slots={k_total:.0f}) the band test that separates hierarchy "
+            f"tiers would misclassify rule conformance.  Add nodes, split "
+            f"the problem, or raise _RULE_TIER in concert with "
+            f"_RULE_MISS/_INF (blance_tpu/plan/tensor.py)")
+    if len(_tier_scale_memo) >= 256:  # bound a long-lived process's memo
+        _tier_scale_memo.clear()
+    _tier_scale_memo[key] = fingerprint
+
+
 def solve_dense_converged(
     prev: jnp.ndarray,
     pweights: jnp.ndarray,
@@ -1441,6 +1535,8 @@ def solve_dense_converged(
     jit/shard_map trace; the sharded entry point builds its carry
     host-side instead.)
     """
+    _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
+                           constraints, rules)
     out, sweeps = _solve_dense_converged_impl(
         prev, pweights, nweights, valid, stickiness, gids, gid_valid,
         constraints, rules, axis_name, max_iterations, node_axis,
@@ -1573,6 +1669,8 @@ def solve_dense_warm(
     pass.
     """
     rec = get_recorder()
+    _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
+                           constraints, rules)
     dirty_np = np.asarray(dirty)
     if record:
         rec.observe("plan.solve.dirty_fraction",

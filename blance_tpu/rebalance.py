@@ -7,25 +7,43 @@ story built in: the PartitionMap IS the checkpoint (JSON-serializable by
 design, reference api.go:30-35), so a crashed rebalance resumes by
 re-planning from the current map and orchestrating the remaining diff —
 the planner is pure and idempotent at fixpoint (plan_test.go:1888-1908).
+
+Failure-aware recovery (docs/DESIGN.md "Failure semantics & recovery"):
+when the orchestrator options enable fault tolerance (deadlines /
+retries / quarantine) and ``max_recovery_rounds > 0``, an orchestration
+pass that left failed moves or quarantined nodes re-enters the planner —
+quarantined nodes become ``nodes_to_remove``, the reconstructed achieved
+map (with dead-node placements presumed lost) becomes the current map —
+and runs another bounded pass.  Each round's outcome lands in
+``RebalanceResult.rounds``; the node health tracker carries across
+rounds so a dead node stays dead.  With a ``PlannerSession`` supplied,
+recovery replans warm-start off the session's solver carry whenever the
+failures were confined to the dead nodes (the only rows that differ from
+the adopted proposal are exactly the rows the removal marks dirty).
 """
 
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import json
 import os
+import tempfile
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .core.types import (
+    Partition,
     PartitionMap,
     PartitionModel,
     PlanOptions,
     partition_map_from_json,
     partition_map_to_json,
 )
+from .obs import get_recorder
 from .orchestrate.orchestrator import (
     FindMoveFunc,
+    MoveFailure,
     OrchestratorOptions,
     OrchestratorProgress,
     lowest_weight_partition_move_for_node,
@@ -36,11 +54,23 @@ from .utils.trace import PhaseTimer
 
 __all__ = [
     "RebalanceResult",
+    "RecoveryRound",
     "rebalance",
     "rebalance_async",
     "save_partition_map",
     "load_partition_map",
 ]
+
+
+@dataclass
+class RecoveryRound:
+    """Outcome of one orchestration pass (round 0 = the primary pass)."""
+
+    round: int
+    dead_nodes: list[str]  # quarantined when the pass ENDED
+    failures: int  # MoveFailures recorded during this pass
+    progress_events: int
+    progress: OrchestratorProgress
 
 
 @dataclass
@@ -52,19 +82,81 @@ class RebalanceResult:
     progress: OrchestratorProgress
     progress_events: int
     timer: PhaseTimer = field(default_factory=PhaseTimer)
+    # -- fault-tolerant mode extras (empty/None in legacy mode) --
+    failures: list[MoveFailure] = field(default_factory=list)
+    rounds: list[RecoveryRound] = field(default_factory=list)
+    # The reconstructed map the cluster actually reached (== next_map on
+    # a clean run); populated only when fault tolerance is on.
+    achieved_map: Optional[PartitionMap] = None
+    quarantined_nodes: list[str] = field(default_factory=list)
 
 
 def save_partition_map(pmap: PartitionMap, path: str) -> None:
-    """Checkpoint a map as JSON (atomic rename)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(partition_map_to_json(pmap), f)
-    os.replace(tmp, path)
+    """Checkpoint a map as JSON, atomically.
+
+    A crash mid-write must never leave a torn checkpoint: the JSON goes
+    to a uniquely-named temp file IN THE SAME DIRECTORY (os.replace is
+    only atomic within a filesystem), is fsync'd so the rename cannot be
+    reordered before the data blocks, then os.replace'd into place.  A
+    failure on any step removes the temp file and re-raises — the
+    previous checkpoint survives untouched.
+    """
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory)
+    try:
+        # mkstemp creates 0600; os.replace would carry that restrictive
+        # mode onto the checkpoint and break unprivileged readers
+        # (monitoring, backups).  Preserve the existing checkpoint's
+        # mode, or umask-default for a fresh one.
+        try:
+            mode = os.stat(path).st_mode & 0o777
+        except FileNotFoundError:
+            umask = os.umask(0)
+            os.umask(umask)
+            mode = 0o666 & ~umask
+        os.fchmod(fd, mode)
+        with os.fdopen(fd, "w") as f:
+            json.dump(partition_map_to_json(pmap), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_partition_map(path: str) -> PartitionMap:
     with open(path) as f:
         return partition_map_from_json(json.load(f))
+
+
+def _session_matches(session, cur: PartitionMap) -> bool:
+    """True when the session's adopted current state already IS ``cur``
+    — then load_map (which invalidates the warm carry) can be skipped
+    and a repeat rebalance through the same session warm-starts its
+    primary plan off the carry the previous call promoted."""
+    try:
+        current, _warns = session.to_map("current")
+    except Exception:
+        return False
+    return current == cur
+
+
+def _strip_nodes(pmap: PartitionMap, nodes: set) -> PartitionMap:
+    """Drop every placement on ``nodes`` — the recovery presumption that
+    a quarantined node's data is lost, so no 'del' move is owed to it."""
+    if not nodes:
+        return pmap
+    return {
+        name: Partition(name, {
+            s: [n for n in ns if n not in nodes]
+            for s, ns in p.nodes_by_state.items()})
+        for name, p in pmap.items()
+    }
 
 
 async def rebalance_async(
@@ -81,53 +173,175 @@ async def rebalance_async(
     backend: str = "auto",
     on_progress: Optional[Callable[[OrchestratorProgress], None]] = None,
     checkpoint_path: Optional[str] = None,
+    max_recovery_rounds: int = 0,
+    session=None,
 ) -> RebalanceResult:
     """Plan the next map and execute the transition against the callback.
 
     assign_partitions(stop_ch, node, partitions, states, ops) is the app's
     data plane (sync or async).  on_progress sees every progress snapshot.
-    checkpoint_path, if set, saves the planned target map before
-    orchestration begins; on a mid-orchestration crash, resume by re-running
-    rebalance from the app's current map (the planner is idempotent at
-    fixpoint, so the redo converges) or diff current vs the checkpointed
-    target directly.
+    checkpoint_path, if set, saves each round's planned target map
+    (atomically) before its orchestration begins; on a mid-orchestration
+    crash, resume by re-running rebalance from the app's current map (the
+    planner is idempotent at fixpoint, so the redo converges) or diff
+    current vs the checkpointed target directly.
+
+    max_recovery_rounds (requires fault-tolerant orchestrator options):
+    after a pass that left MoveFailures or quarantined nodes, up to this
+    many recovery passes replan with the quarantined nodes removed and
+    the achieved map (dead placements stripped) as current.  session, a
+    plan.session.PlannerSession covering the same partitions/nodes, makes
+    the planning incremental: recovery replans warm-start off the solver
+    carry when the failures were confined to the dead nodes.
     """
     timer = PhaseTimer()
-    with timer.phase("plan"):
-        next_map, warnings = plan_next_map(
-            current_map, current_map, nodes_all,
-            nodes_to_remove, nodes_to_add, model,
-            plan_options, backend=backend)
+    rec = get_recorder()
+    opts = orchestrator_options or OrchestratorOptions()
+    ft = opts.fault_tolerant
+    if max_recovery_rounds > 0 and not ft:
+        raise ValueError(
+            "max_recovery_rounds needs fault-tolerant orchestrator options "
+            "(move_timeout_s / max_retries / quarantine_after): the legacy "
+            "path aborts on the first error and records no failures to "
+            "recover from")
 
-    if checkpoint_path:
-        with timer.phase("checkpoint"):
-            save_partition_map(next_map, checkpoint_path)
+    all_warnings: dict[str, list[str]] = {}
 
-    events = 0
-    with timer.phase("orchestrate"):
-        o = orchestrate_moves(
-            model,
-            orchestrator_options or OrchestratorOptions(),
-            nodes_all,
-            current_map,
-            next_map,
-            assign_partitions,
-            find_move or lowest_weight_partition_move_for_node,
-        )
-        final = OrchestratorProgress()
-        async for progress in o.progress_ch():
-            events += 1
-            final = progress
-            if on_progress is not None:
-                on_progress(progress)
-        o.stop()
+    def plan(cur: PartitionMap, removes: list[str], adds: list[str],
+             warm_ok: bool, recovery: bool) -> PartitionMap:
+        """One planner entry; merges warnings.  With a session: adopt
+        ``cur`` unless the session's adopted state already matches
+        (warm_ok — the recovery fast path), apply the delta, replan.
+        Recovery rounds go through the session's dedicated entry
+        (``recovery_replan``) so the failure-aware replan has exactly
+        one spelling."""
+        if session is None:
+            next_map, warns = plan_next_map(
+                cur, cur, nodes_all, removes, adds, model,
+                plan_options, backend=backend)
+        else:
+            if not warm_ok and not _session_matches(session, cur):
+                session.load_map(cur)  # cold: invalidates any carry
+            if recovery:
+                session.recovery_replan(removes)  # adds is always [] here
+            else:
+                if adds:
+                    session.add_nodes(adds)
+                if removes:
+                    session.remove_nodes(removes)
+                session.replan()
+            next_map, warns = session.to_map("proposed")
+        for k, v in warns.items():
+            all_warnings.setdefault(k, []).extend(v)
+        return next_map
+
+    beg = current_map
+    removes = list(nodes_to_remove or [])
+    adds = list(nodes_to_add or [])
+    rounds: list[RecoveryRound] = []
+    all_failures: list[MoveFailure] = []
+    events_total = 0
+    health = opts.health
+    warm_ok = False
+    final: OrchestratorProgress = OrchestratorProgress()
+    next_map: PartitionMap = beg
+    achieved: Optional[PartitionMap] = None
+    quarantined: list[str] = []
+
+    for round_i in range(1 + max(max_recovery_rounds, 0)):
+        phase = "plan" if round_i == 0 else f"recovery_plan_{round_i}"
+        with timer.phase(phase):
+            next_map = plan(beg, removes, adds, warm_ok,
+                            recovery=round_i > 0)
+
+        if checkpoint_path:
+            with timer.phase("checkpoint"):
+                save_partition_map(next_map, checkpoint_path)
+
+        events = 0
+        orch_phase = "orchestrate" if round_i == 0 \
+            else f"recovery_orchestrate_{round_i}"
+        with timer.phase(orch_phase):
+            round_opts = opts
+            if ft and health is not None:
+                # Quarantine state carries across rounds: a node that
+                # tripped in round k stays dark in round k+1 unless its
+                # half-open probe heals it.
+                round_opts = dataclasses.replace(opts, health=health)
+            orch_nodes = [n for n in nodes_all if n not in quarantined]
+            o = orchestrate_moves(
+                model,
+                round_opts,
+                orch_nodes,
+                beg,
+                next_map,
+                assign_partitions,
+                find_move or lowest_weight_partition_move_for_node,
+            )
+            async for progress in o.progress_ch():
+                events += 1
+                final = progress
+                if on_progress is not None:
+                    on_progress(progress)
+            o.stop()
+
+        events_total += events
+        round_failures = o.move_failures()
+        all_failures.extend(round_failures)
+        health = o.health
+        quarantined = health.quarantined_nodes() if health is not None \
+            else []
+        rounds.append(RecoveryRound(
+            round=round_i, dead_nodes=list(quarantined),
+            failures=len(round_failures), progress_events=events,
+            progress=final))
+        if ft:
+            achieved = _strip_nodes(o.achieved_map(), set(quarantined))
+
+        if not ft or not round_failures:
+            # Converged (or legacy mode, which never recovers): a
+            # quarantined node with zero failures this round means the
+            # plan already routed around it.  With a session, a clean
+            # pass adopts the proposal so the next plan — this
+            # rebalance's or a later one — warm-starts off the carry.
+            if session is not None and not round_failures and \
+                    not final.errors:
+                session.apply()
+            break
+        if round_i >= max_recovery_rounds:
+            break
+
+        # -- set up the recovery round ------------------------------------
+        rec.count("rebalance.recovery_rounds")
+        if session is not None:
+            # Warm fast path: failures confined to the dead nodes mean
+            # the achieved state differs from the adopted proposal only
+            # on rows that held a dead-node copy — exactly the rows
+            # remove_nodes(dead) marks dirty, so the carry stays sound.
+            confined = bool(quarantined) and all(
+                f.node in set(quarantined) for f in round_failures)
+            if confined:
+                session.apply()
+                warm_ok = True
+            else:
+                warm_ok = False
+        beg = achieved
+        # The original removal intent persists until drained: a node the
+        # caller was decommissioning must not be re-adopted just because
+        # a failed round left copies on it.  Quarantined nodes join it.
+        removes = sorted(set(removes) | set(quarantined))
+        adds = []
 
     return RebalanceResult(
         next_map=next_map,
-        warnings=warnings,
+        warnings=all_warnings,
         progress=final,
-        progress_events=events,
+        progress_events=events_total,
         timer=timer,
+        failures=all_failures,
+        rounds=rounds,
+        achieved_map=achieved,
+        quarantined_nodes=list(quarantined),
     )
 
 

@@ -1,0 +1,456 @@
+"""Deterministic chaos tests: fault injection, retries, deadlines,
+quarantine, and failure-aware recovery replans.
+
+Everything here is CPU-only, seeded, and wall-clock-free apart from
+millisecond-scale timeouts/backoffs (hangs are virtual: a parked
+callback cancelled by the move deadline).  The 3-seed scenario
+parametrization is what the CI chaos-smoke job runs on every PR.
+"""
+
+import asyncio
+
+import pytest
+
+from blance_tpu import Partition, PartitionModelState, model
+from blance_tpu.obs import Recorder, use_recorder
+from blance_tpu.orchestrate import (
+    FaultPlan,
+    HealthTracker,
+    MissingMoverError,
+    MoveFailure,
+    MoveTimeoutError,
+    NodeFaults,
+    OrchestratorOptions,
+    orchestrate_moves,
+)
+from blance_tpu.rebalance import rebalance
+
+MR_MODEL = {
+    "primary": PartitionModelState(priority=0, constraints=0),
+    "replica": PartitionModelState(priority=0, constraints=1),
+}
+M = model(primary=(0, 1), replica=(1, 1))
+
+SEEDS = [3, 11, 42]  # the CI chaos-smoke matrix
+
+
+def pm(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+def round_robin_map(n_parts, nodes):
+    return pm({
+        f"{i:02d}": {"primary": [nodes[i % len(nodes)]],
+                     "replica": [nodes[(i + 1) % len(nodes)]]}
+        for i in range(n_parts)
+    })
+
+
+def ft_opts(**kw):
+    base = dict(move_timeout_s=0.25, max_retries=4, backoff_base_s=0.002,
+                backoff_jitter=0.25, quarantine_after=3, probe_after_s=60.0)
+    base.update(kw)
+    return OrchestratorOptions(**base)
+
+
+def make_cluster_tracker(beg):
+    """An assign callback applying ops to a dict cluster model, so the
+    app's view can be cross-checked against achieved_map."""
+    cluster = {p: {s: list(ns) for s, ns in part.nodes_by_state.items()}
+               for p, part in beg.items()}
+
+    def assign(stop_ch, node, partitions, states, ops):
+        for p, s, _op in zip(partitions, states, ops):
+            for ns in cluster[p].values():
+                if node in ns:
+                    ns.remove(node)
+            if s:
+                cluster[p].setdefault(s, []).append(node)
+
+    return cluster, assign
+
+
+def assert_map_complete(pmap, allowed_nodes, label=""):
+    """Zero unassigned and zero duplicated placements, on live nodes."""
+    for name, part in pmap.items():
+        nbs = part.nodes_by_state if hasattr(part, "nodes_by_state") else part
+        placed = [n for ns in nbs.values() for n in ns]
+        assert len(placed) == len(set(placed)), \
+            f"{label}: duplicate placement in {name}: {placed}"
+        assert len(nbs.get("primary", [])) == 1, \
+            f"{label}: {name} primaries: {nbs.get('primary')}"
+        assert len(nbs.get("replica", [])) == 1, \
+            f"{label}: {name} replicas: {nbs.get('replica')}"
+        assert all(n in allowed_nodes for n in placed), \
+            f"{label}: {name} placed on dead node: {placed}"
+
+
+# --- the acceptance scenario: flaky 30% + one dead node ---------------------
+
+
+def run_chaos_rebalance(seed):
+    """Flaky node at 30% + one dead node; recovery bounded at 2 rounds.
+    Returns (result, plan, recorder, cluster)."""
+    live = ["a", "b", "c", "d"]
+    nodes = live + ["e"]  # e joins the cluster... and is dead on arrival
+    beg = round_robin_map(16, live)
+    cluster, assign = make_cluster_tracker(beg)
+    plan = FaultPlan(seed=seed, nodes={
+        "b": NodeFaults(fail_rate=0.3),
+        "e": NodeFaults(dead=True),
+    })
+    rec = Recorder()
+    with use_recorder(rec):
+        result = rebalance(
+            M, beg, nodes, [], ["e"], plan.wrap(assign),
+            orchestrator_options=ft_opts(),
+            max_recovery_rounds=2,
+        )
+    return result, plan, rec, cluster
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_flaky_plus_dead_node_recovers(seed):
+    result, plan, rec, cluster = run_chaos_rebalance(seed)
+
+    # The dead node tripped quarantine and caused structured failures.
+    assert plan.injected.get("fail", 0) > 0
+    assert result.failures, "chaos produced no MoveFailures?"
+    assert all(isinstance(f, MoveFailure) for f in result.failures)
+    assert any(f.node == "e" for f in result.failures)
+    assert "e" in result.quarantined_nodes
+    assert rec.counters.get("orchestrate.quarantine_trips", 0) >= 1
+
+    # Recovery ran (bounded) and the final reconstructed map is whole:
+    # every partition fully placed on live nodes, no duplicates.
+    assert len(result.rounds) >= 2
+    assert rec.counters.get("rebalance.recovery_rounds", 0) >= 1
+    last = result.rounds[-1]
+    assert last.failures == 0, \
+        f"final round still failing: {result.failures[-3:]}"
+    quarantined = set(result.quarantined_nodes)
+    allowed = set("abcd") - quarantined
+    assert_map_complete(result.achieved_map, allowed, f"seed={seed}")
+    # The app's own cluster view agrees with the reconstruction.
+    for name, part in result.achieved_map.items():
+        got = {s: sorted(ns) for s, ns in cluster[name].items() if ns}
+        want = {s: sorted(ns) for s, ns in part.nodes_by_state.items() if ns}
+        assert got == want, (name, got, want)
+    # Retries happened (the flaky node) and the failure history is full:
+    # every failure names a (node, partition, state, op, attempts, cause).
+    assert rec.counters.get("orchestrate.retries", 0) > 0
+    for f in result.failures:
+        assert f.partition and f.node and f.op and f.attempts >= 0
+        assert f.cause is not None
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_same_seed_reproduces_identical_counters(seed):
+    keys = ("orchestrate.retries", "orchestrate.timeouts",
+            "orchestrate.quarantine_trips", "orchestrate.move_failures",
+            "orchestrate.missing_mover", "rebalance.recovery_rounds")
+
+    def run():
+        result, plan, rec, _cluster = run_chaos_rebalance(seed)
+        counters = {k: rec.counters.get(k, 0) for k in keys}
+        return counters, dict(plan.injected), len(result.failures)
+
+    assert run() == run()
+
+
+def test_recovery_with_planner_session_warm_carry():
+    """Recovery replans through a PlannerSession: the dead node's rows
+    are re-placed (warm off the promoted carry when the gates allow,
+    cold otherwise), the session adopts the recovery proposal, and the
+    final map is whole on the surviving nodes."""
+    from blance_tpu.plan.session import PlannerSession
+
+    live = ["a", "b", "c", "d"]
+    nodes = live + ["e"]
+    beg = round_robin_map(16, live)
+    session = PlannerSession(M, nodes, sorted(beg))
+    cluster, assign = make_cluster_tracker(beg)
+    plan = FaultPlan(seed=7, nodes={"e": NodeFaults(dead=True)})
+    rec = Recorder()
+    with use_recorder(rec):
+        # d decommissions while e joins — so the plan MUST route load
+        # onto e, which is dead on arrival.
+        result = rebalance(
+            M, beg, nodes, ["d"], ["e"], plan.wrap(assign),
+            orchestrator_options=ft_opts(),
+            max_recovery_rounds=2,
+            session=session,
+        )
+
+    assert result.quarantined_nodes == ["e"]
+    assert result.rounds[-1].failures == 0
+    assert rec.counters.get("rebalance.recovery_rounds", 0) >= 1
+    assert_map_complete(result.achieved_map, {"a", "b", "c"},
+                        "session recovery")
+    # The session adopted the recovery proposal as its current state.
+    current, _warns = session.to_map("current")
+    assert current == result.next_map
+    # Failures were confined to the dead node, so the session path kept
+    # its carry alive across the recovery replan (warm attempt or a
+    # gated cold fallback — either way the solve ran through the
+    # session, visible as carry accounting).
+    assert any(k.startswith("plan.solve.carry") or
+               k == "plan.solve.warm_fallback" for k in rec.counters)
+
+
+# --- deadlines: hung callbacks are cancelled, not waited on forever ---------
+
+
+def test_hung_node_hits_move_deadline():
+    nodes = ["a", "h"]
+    beg = pm({"00": {"primary": ["a"]}, "01": {"primary": ["a"]}})
+    end = pm({"00": {"primary": ["h"]}, "01": {"primary": ["a"]}})
+    plan = FaultPlan(seed=5, nodes={"h": NodeFaults(dead=True, hang=True)})
+    rec = Recorder()
+
+    async def go():
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MR_MODEL,
+            ft_opts(move_timeout_s=0.02, max_retries=1, quarantine_after=2),
+            nodes, beg, end, plan.wrap(assign))
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+        return o
+
+    with use_recorder(rec):
+        o = asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+    assert plan.injected.get("hang", 0) > 0
+    assert rec.counters.get("orchestrate.timeouts", 0) > 0
+    fails = o.move_failures()
+    assert fails and all(f.node == "h" for f in fails)
+    assert any(isinstance(f.cause, MoveTimeoutError) for f in fails)
+    # The untouched partition's plan had no moves; the hung one was
+    # abandoned — either way the stream closed and nothing wedged.
+
+
+def test_repeat_rebalance_through_session_keeps_carry_warm():
+    """A second rebalance through the same (adopted) session must not
+    cold-reload: the session's current state already matches, so the
+    primary plan warm-starts off the carry the first call promoted."""
+    from blance_tpu.plan.session import PlannerSession
+
+    nodes = ["a", "b", "c", "d"]
+    beg = round_robin_map(12, nodes)
+    session = PlannerSession(M, nodes, sorted(beg))
+    _cluster, assign = make_cluster_tracker(beg)
+
+    first = rebalance(M, beg, nodes, [], [], assign,
+                      orchestrator_options=ft_opts(), session=session)
+    assert not first.failures
+    assert session._carry is not None, "clean pass did not promote carry"
+
+    rec = Recorder()
+    with use_recorder(rec):
+        second = rebalance(M, first.next_map, nodes, [], [], assign,
+                           orchestrator_options=ft_opts(), session=session)
+    assert not second.failures
+    # No cold reload: the fixpoint replan consumed the carry (hit), and
+    # load_map's invalidate (a guaranteed carry_miss) never ran.
+    assert rec.counters.get("plan.solve.carry_hit", 0) >= 1
+    assert rec.counters.get("plan.solve.carry_miss", 0) == 0
+
+
+def test_app_raised_timeout_error_is_not_rebranded():
+    """On 3.11+ asyncio.TimeoutError IS builtin TimeoutError: an app
+    data plane raising its own timeout must surface as the APP's error
+    (cause preserved, no orchestrate.timeouts bump), in both modes."""
+    nodes = ["a", "b"]
+    beg = pm({"00": {"primary": ["a"]}})
+    end = pm({"00": {"primary": ["b"]}})
+    the_err = TimeoutError("socket recv timed out")
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        raise the_err
+
+    async def go(options):
+        o = orchestrate_moves(MR_MODEL, options, nodes, beg, end, assign)
+        last = None
+        async for p in o.progress_ch():
+            last = p
+        o.stop()
+        return o, last
+
+    # Legacy: aborts with the app's exception, zero timeout accounting.
+    rec = Recorder()
+    with use_recorder(rec):
+        _o, last = asyncio.run(
+            asyncio.wait_for(go(OrchestratorOptions()), timeout=30))
+    assert the_err in last.errors
+    assert last.tot_mover_assign_partition_timeout == 0
+    assert rec.counters.get("orchestrate.timeouts", 0) == 0
+
+    # Fault-tolerant with a deadline: the MoveFailure cause is the app's
+    # TimeoutError, not a MoveTimeoutError rebranding.
+    rec = Recorder()
+    with use_recorder(rec):
+        o, last = asyncio.run(asyncio.wait_for(
+            go(ft_opts(max_retries=0)), timeout=30))
+    fails = o.move_failures()
+    assert fails and all(f.cause is the_err for f in fails)
+    assert not any(isinstance(f.cause, MoveTimeoutError) for f in fails)
+    assert rec.counters.get("orchestrate.timeouts", 0) == 0
+
+
+# --- quarantine breaker: state machine + half-open healing ------------------
+
+
+def test_health_tracker_state_machine_virtual_time():
+    t = [0.0]
+    h = HealthTracker(threshold=2, probe_after_s=10.0, clock=lambda: t[0])
+    assert h.admit("n") == "ok"
+    assert h.record_failure("n") is False
+    assert h.record_failure("n") is True  # second consecutive: trip
+    assert h.state("n") == "quarantined"
+    assert h.admit("n") == "reject"
+    assert h.quarantined_nodes() == ["n"]
+
+    t[0] = 10.0  # dwell elapsed: exactly one probe admitted
+    assert h.admit("n") == "probe"
+    assert h.admit("n") == "reject"  # probe in flight
+    assert h.record_failure("n") is True  # probe failed: re-trip
+    assert h.admit("n") == "reject"  # dwell restarted
+
+    t[0] = 20.0
+    assert h.admit("n") == "probe"
+    h.record_success("n")  # probe succeeded: healed
+    assert h.state("n") == "healthy"
+    assert h.admit("n") == "ok"
+    assert h.quarantined_nodes() == []
+    assert h.total_trips() == 2
+
+
+def test_recovered_node_readmitted_via_probe():
+    """A node that fails its first attempts then heals: the breaker
+    trips, the dwell elapses (probe_after_s=0 keeps it virtual), and the
+    half-open probe re-admits it — moves complete on the node itself."""
+    nodes = ["a", "f"]
+    beg = pm({f"{i:02d}": {"primary": ["a"], "replica": []}
+              for i in range(6)})
+    end = pm({f"{i:02d}": {"primary": ["a"], "replica": ["f"]}
+              for i in range(6)})
+    plan = FaultPlan(seed=2, nodes={"f": NodeFaults(dead=True, heal_after=4)})
+
+    async def go():
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MR_MODEL,
+            ft_opts(max_retries=0, quarantine_after=2, probe_after_s=0.0),
+            nodes, beg, end, plan.wrap(assign))
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+        return o
+
+    o = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert o.health.state("f") == "healthy"
+    assert plan.injected.get("ok", 0) > 0  # post-heal moves executed
+    # Some moves landed after healing: not every partition failed.
+    failed = {f.partition for f in o.move_failures()}
+    assert len(failed) < 6
+
+
+# --- missing mover: surfaced, and fail-fast under a deadline ----------------
+
+
+def test_missing_mover_fails_fast_with_deadline():
+    nodes = ["a"]  # "ghost" deliberately absent
+    beg = pm({"00": {"primary": ["a"]}, "01": {"primary": ["a"]}})
+    end = pm({"00": {"primary": ["ghost"]}, "01": {"primary": ["a"]}})
+    rec = Recorder()
+
+    async def go():
+        def assign(stop_ch, node, partitions, states, ops):
+            return None
+
+        o = orchestrate_moves(MR_MODEL, ft_opts(), nodes, beg, end, assign)
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+        return o
+
+    with use_recorder(rec):
+        with pytest.warns(UserWarning, match="no mover"):
+            o = asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+    assert rec.counters.get("orchestrate.missing_mover", 0) >= 1
+    fails = o.move_failures()
+    assert fails and all(isinstance(f.cause, MissingMoverError)
+                         for f in fails)
+    assert all(f.node == "ghost" for f in fails)
+
+
+def test_missing_mover_legacy_stall_is_surfaced():
+    """Default options keep the reference's wedge-until-stop semantics,
+    but the stall is no longer silent: counter + one-time warning."""
+    nodes = ["a"]
+    beg = pm({"00": {"primary": ["a"]}})
+    end = pm({"00": {"primary": ["ghost"]}})
+    rec = Recorder()
+
+    async def go():
+        def assign(stop_ch, node, partitions, states, ops):
+            return None
+
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), nodes, beg, end, assign)
+        # The ghost feeder blocks; stop() must still wind everything down.
+        await o.progress_ch().get()
+        o.stop()
+        async for _ in o.progress_ch():
+            pass
+
+    with use_recorder(rec):
+        with pytest.warns(UserWarning, match="no mover"):
+            asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+    assert rec.counters.get("orchestrate.missing_mover", 0) >= 1
+
+
+# --- default options: FaultPlan with no faults is a pass-through ------------
+
+
+def test_faultplan_without_faults_is_transparent():
+    nodes = ["a", "b"]
+    beg = round_robin_map(4, nodes)
+    end = pm({f"{i:02d}": {"primary": [nodes[(i + 1) % 2]],
+                           "replica": [nodes[i % 2]]} for i in range(4)})
+    recs = []
+    plan = FaultPlan(seed=9)
+
+    async def go(callback):
+        o = orchestrate_moves(
+            MR_MODEL, OrchestratorOptions(), nodes, beg, end, callback)
+        log = []
+        async for p in o.progress_ch():
+            log.append((p.tot_mover_assign_partition_ok,
+                        p.tot_mover_assign_partition_err, len(p.errors)))
+        o.stop()
+        return log
+
+    def assign(stop_ch, node, partitions, states, ops):
+        recs.append((node, tuple(partitions), tuple(ops)))
+
+    direct = asyncio.run(asyncio.wait_for(go(assign), timeout=30))
+    executed_direct = list(recs)
+    recs.clear()
+    wrapped = asyncio.run(asyncio.wait_for(go(plan.wrap(assign)), timeout=30))
+    # The wrapper makes the callback async, which may interleave rounds
+    # differently — the SET of executed moves and the final counters must
+    # be identical, fault-free.
+    assert sorted(recs) == sorted(executed_direct)
+    assert wrapped[-1] == direct[-1]
+    assert plan.injected.get("fail", 0) == 0 and \
+        plan.injected.get("hang", 0) == 0

@@ -16,7 +16,14 @@ import random
 import pytest
 
 from blance_tpu import Partition, PartitionModelState
-from blance_tpu.orchestrate import OrchestratorOptions, orchestrate_moves
+from blance_tpu.orchestrate import (
+    Chan,
+    FaultPlan,
+    MoveFailure,
+    NodeFaults,
+    OrchestratorOptions,
+    orchestrate_moves,
+)
 
 MODEL = {
     "primary": PartitionModelState(priority=0, constraints=0),
@@ -107,6 +114,165 @@ def test_stop_storm_never_hangs():
         async for _ in o.progress_ch():
             o.stop()
     asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+def _ft_options(**kw):
+    base = dict(move_timeout_s=0.25, max_retries=2, backoff_base_s=0.002,
+                backoff_jitter=0.25, quarantine_after=2, probe_after_s=60.0)
+    base.update(kw)
+    return OrchestratorOptions(**base)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_counters_monotonic_and_errors_append_only_under_faults(seed):
+    """Injected faults must never make a progress counter regress, and
+    the errors list must be append-only (every earlier snapshot a prefix
+    of every later one) with MoveFailure entries only."""
+    rng = random.Random(seed)
+    nodes = ["a", "b", "c", "d"]
+    beg, end = build_maps(10, nodes, rng)
+    plan = FaultPlan(seed=seed, nodes={
+        "b": NodeFaults(fail_rate=0.4),
+        "c": NodeFaults(fail_rate=0.2),
+    })
+
+    async def go():
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MODEL, _ft_options(), nodes, beg, end, plan.wrap(assign))
+        last = None
+        monotone = [f.name for f in
+                    type(o._progress).__dataclass_fields__.values()
+                    if f.name != "errors"]
+        async for progress in o.progress_ch():
+            if last is not None:
+                for name in monotone:
+                    assert getattr(progress, name) >= getattr(last, name), \
+                        name
+                # errors: append-only, earlier list is a prefix.
+                assert progress.errors[:len(last.errors)] == last.errors
+            assert all(isinstance(e, MoveFailure) for e in progress.errors)
+            last = progress
+        o.stop()
+        return last, o
+
+    last, o = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert last is not None
+    assert last.tot_move_failures == len(o.move_failures())
+    assert len(last.errors) == last.tot_move_failures
+
+
+def test_pause_resume_during_retry_backoff():
+    """Pause/resume while a mover sits in a retry backoff: the backoff
+    finishes, the retry runs, and the orchestration completes with
+    balanced pause/resume counters."""
+    nodes = ["a", "b"]
+    beg = pm({f"{i}": {"primary": ["a"], "replica": []} for i in range(4)})
+    end = pm({f"{i}": {"primary": ["b"], "replica": []} for i in range(4)})
+    # b's first 2 node-attempts fail, then it heals: guaranteed retries.
+    plan = FaultPlan(seed=1, nodes={"b": NodeFaults(dead=True,
+                                                    heal_after=2)})
+
+    async def go():
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MODEL,
+            _ft_options(max_retries=4, backoff_base_s=0.02,
+                        quarantine_after=0),
+            nodes, beg, end, plan.wrap(assign))
+        paused = False
+        last = None
+        async for progress in o.progress_ch():
+            last = progress
+            if not paused and progress.tot_mover_assign_partition_retry >= 1:
+                o.pause_new_assignments()
+                o.resume_new_assignments()
+                paused = True
+        o.stop()
+        return last, paused
+
+    last, paused = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert paused, "no retry was observed"
+    assert last.tot_pause_new_assignments == 1
+    assert last.tot_resume_new_assignments == 1
+    assert last.tot_mover_assign_partition_retry >= 1
+    # The healed node eventually accepted everything.
+    assert last.tot_mover_assign_partition_ok >= 1
+
+
+def test_stop_during_quarantine_never_hangs():
+    """stop() right after a node trips into quarantine: the wind-down
+    must complete even with batches queued for the dead node."""
+    nodes = ["a", "b", "dead"]
+    beg = pm({f"{i}": {"primary": ["a"], "replica": []} for i in range(8)})
+    end = pm({f"{i}": {"primary": ["dead"], "replica": []} for i in range(8)})
+    plan = FaultPlan(seed=4, nodes={"dead": NodeFaults(dead=True)})
+
+    async def go():
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        o = orchestrate_moves(
+            MODEL, _ft_options(max_retries=1, quarantine_after=1),
+            nodes, beg, end, plan.wrap(assign))
+        last = None
+        async for progress in o.progress_ch():
+            last = progress
+            if progress.tot_quarantine_trips >= 1:
+                o.stop()
+        return last
+
+    last = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert last is not None
+    assert last.tot_quarantine_trips >= 1
+    assert last.tot_progress_close <= 1
+
+
+# --- csp hardening: abandoned waiters (cancelled timed waits) ---------------
+
+
+def test_chan_close_tolerates_cancelled_getter():
+    """A getter whose awaiting task was cancelled (the shape a retry
+    backoff's aborted stop-watch leaves behind) must not break close()."""
+
+    async def go():
+        ch = Chan()
+        task = asyncio.ensure_future(ch.get())
+        await asyncio.sleep(0)  # let it register
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        ch.close()  # must not raise InvalidStateError
+        assert await ch.get() == (None, False)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+
+
+def test_chan_put_skips_cancelled_getter():
+    """A put must rendezvous with a LIVE getter, not hand its item to an
+    abandoned one (which would silently drop it)."""
+
+    async def go():
+        ch = Chan()
+        g1 = asyncio.ensure_future(ch.get())
+        await asyncio.sleep(0)
+        g1.cancel()
+        try:
+            await g1
+        except asyncio.CancelledError:
+            pass
+        g2 = asyncio.ensure_future(ch.get())
+        await asyncio.sleep(0)
+        await ch.put("x")
+        assert await g2 == ("x", True)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
 
 
 def test_ops_follow_per_partition_move_plans():

@@ -62,6 +62,25 @@ def test_checkpoint_round_trip(tmp_path):
     path = str(tmp_path / "map.json")
     save_partition_map(pmap, path)
     assert load_partition_map(path) == pmap
+    # Atomic write must not leak its temp file alongside the checkpoint.
+    assert os.listdir(tmp_path) == ["map.json"]
+
+
+def test_checkpoint_write_preserves_permissions(tmp_path):
+    """The atomic tmp+rename must not tighten the checkpoint's mode to
+    mkstemp's 0600: fresh files honor the umask, existing files keep
+    their mode (unprivileged monitoring/backup readers stay working)."""
+    pmap = {"x": Partition("x", {"primary": ["a"]})}
+    path = str(tmp_path / "map.json")
+    old_umask = os.umask(0o022)
+    try:
+        save_partition_map(pmap, path)
+        assert os.stat(path).st_mode & 0o777 == 0o644
+        os.chmod(path, 0o664)
+        save_partition_map(pmap, path)  # overwrite keeps the custom mode
+        assert os.stat(path).st_mode & 0o777 == 0o664
+    finally:
+        os.umask(old_umask)
 
 
 def test_legacy_signature():

@@ -510,6 +510,31 @@ def test_validation_gate_catches_broken_hier_penalty(monkeypatch):
             M_1P_2R, opts)
 
 
+def test_tier_band_scale_guard_trips_on_extreme_p_over_n():
+    """The tier-equality band assumes within-tier score terms stay far
+    below _RULE_TIER; at extreme partitions-per-node ratios the fill
+    term crosses the band and the solve must refuse loudly instead of
+    silently misclassifying hierarchy tiers."""
+    from blance_tpu.plan import tensor as T
+
+    P, N = 20_000, 2
+    prev = np.full((P, 1, 1), -1, np.int32)
+    pweights = np.ones(P, np.float32)
+    nweights = np.ones(N, np.float32)
+    valid = np.ones(N, bool)
+    stickiness = np.full((P, 1), 1.5, np.float32)
+    gids = np.stack([np.arange(N, dtype=np.int32),
+                     np.zeros(N, np.int32)])
+    gid_valid = np.ones((2, N), bool)
+    with pytest.raises(ValueError, match="tier band"):
+        T.solve_dense_converged(
+            prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+            (1,), (((1, 0),),))
+    # Rule-less problems never consult the band: the guard is a no-op.
+    T._check_tier_band_scale(
+        prev, pweights, nweights, valid, stickiness, (1,), ((),))
+
+
 def test_degenerate_empty_partitions():
     # P == 0 must not crash the vectorized decode (tensor.py routes it there).
     result, warnings = plan_next_map(
