@@ -727,10 +727,42 @@ def _run_perf_smoke():
     """The CI perf gate (bench.py --perf-smoke): delta-replan at smoke
     size on CPU; exit 1 when warm sweeps fail to beat cold sweeps or the
     warm map diverges — so the warm path cannot silently regress to (or
-    past) a cold solve."""
+    past) a cold solve.
+
+    Runs the static shape-contract audit FIRST (blance_tpu.analysis
+    shape_audit, eval_shape only — milliseconds per entry): a drifted
+    solver signature would otherwise surface here as an opaque stack
+    trace mid-benchmark.  A broken static pass emits a PARSEABLE JSON
+    artifact with ``"pass": false`` and exits 1, same shape as the perf
+    result, so the driver always gets data."""
     import jax
 
     log(f"perf-smoke on {jax.default_backend()}")
+    try:
+        from blance_tpu.analysis.shape_audit import run_shape_audit
+
+        shape_findings, shape_entries = run_shape_audit()
+    except Exception as e:
+        shape_findings, shape_entries = [
+            f"shape audit crashed: {type(e).__name__}: {first_line(e)}"
+        ], 0
+    if shape_findings:
+        rendered = [f if isinstance(f, str) else f.render()
+                    for f in shape_findings]
+        print(json.dumps({
+            "metric": "delta-replan perf smoke (warm vs cold sweeps)",
+            "value": None,
+            "unit": "sweeps",
+            "vs_baseline": None,
+            "detail": {"static_audit": {"entries": shape_entries,
+                                        "findings": rendered}},
+            "pass": False,
+        }))
+        log(f"PERF-SMOKE FAILED: static shape audit broken "
+            f"({len(rendered)} finding(s)); fix the contracts before "
+            f"benchmarking")
+        sys.exit(1)
+    log(f"static shape audit OK ({shape_entries} contracts)")
     res = bench_delta_replan(512, 64)
     ok = (res["identical"] and res["warm_carry_hit"]
           and res["warm_sweeps"] * 2 <= res["cold_sweeps"])

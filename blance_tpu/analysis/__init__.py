@@ -1,0 +1,158 @@
+"""Repo-specific static analysis: the contracts CI can actually enforce.
+
+The solver's correctness rests on invariants no generic tool checks:
+trace-time purity and stable tie-break bits in ``plan/tensor.py`` (the
+warm-replan identity contract), cancellation- and waiter-safety in
+``orchestrate/`` (the cancelled-waiter bug class), and the ``[P, S, N, R]``
+shape conventions that otherwise live only in comments.  TOAST
+(arXiv:2508.15010) makes the case that principled static analysis is the
+scalable way to validate partitioning systems; GSPMD (arXiv:2105.04663)
+leans on statically propagated shape/sharding contracts.  This package is
+blance_tpu's own static layer, run as the ``static`` CI tier:
+
+- :mod:`.jit_purity` — AST lint over functions reachable from
+  ``jax.jit`` / ``shard_map`` trace roots: host nondeterminism, Python
+  branching on traced values, device-sync coercions, captured-state
+  mutation, malformed static args.
+- :mod:`.asyncio_lint` — AST lint over the asyncio control plane:
+  fire-and-forget tasks, blocking calls in ``async def``, silent broad
+  exception swallows, un-deadlined app-callback awaits.
+- :mod:`.shape_audit` — a declarative shape-contract table for the
+  solver's public entry points, checked with ``jax.eval_shape`` across a
+  (P, S, N, R) x bucketing x carry matrix: zero FLOPs, seconds of
+  wall-clock, catches shape/dtype drift before any device sees it.
+- :mod:`.baseline` — the accepted-findings allowlist
+  (``analysis/baseline.toml``): pre-existing findings are pinned with a
+  reason; any NEW finding fails the build.
+
+CLI: ``python -m blance_tpu.analysis [--ci]`` (see __main__.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+__all__ = [
+    "Finding",
+    "run_lints",
+    "run_all",
+    "PACKAGE_ROOT",
+    "REPO_ROOT",
+]
+
+import os
+
+# The package directory the lints walk by default, and the repo root the
+# paths in findings/baseline entries are relative to.
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One static-analysis finding.
+
+    ``symbol`` is the enclosing function's qualname (empty at module
+    level); baseline entries match on (rule, path, symbol) so accepted
+    findings survive unrelated line drift, with ``line`` available for
+    disambiguation when one symbol trips a rule twice.
+    """
+
+    rule: str  # e.g. "JIT001"
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str
+    message: str
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym}: {self.message}"
+
+
+@dataclass
+class AnalysisResult:
+    """Findings split by baseline status, plus bookkeeping for the CLI."""
+
+    new: list[Finding]  # non-baselined findings (these fail the build)
+    baselined: list[tuple[Finding, str]]  # (finding, reason) pairs
+    unused_baseline: list  # BaselineEntry objects that matched nothing
+    checked_files: int = 0
+    shape_entries: int = 0
+    # analyzer crashes (fatal)
+    errors: list[str] = field(default_factory=list)
+
+
+def _iter_py_files(paths: Iterable[str]) -> list:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                # The analysis package lints the product code, not itself
+                # (its own fixtures would trip the rules by design), and
+                # never descends into build trash.
+                dirs[:] = [d for d in dirs if d not in
+                           ("__pycache__", "_native_build", "analysis")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def run_lints(paths: Optional[list] = None) -> tuple:
+    """Run the two AST passes over ``paths`` (default: the package).
+
+    Returns (findings, checked_file_count).  Pure host work — safe to
+    call from anywhere (no jax import).
+    """
+    from .asyncio_lint import lint_file as asyncio_lint_file
+    from .jit_purity import JitPurityPass
+
+    files = _iter_py_files(paths or [PACKAGE_ROOT])
+    findings: list = []
+    # jit purity needs the whole module set up front (cross-module call
+    # resolution); asyncio lint is per-file.
+    jit_pass = JitPurityPass(files, repo_root=REPO_ROOT)
+    findings.extend(jit_pass.run())
+    for f in files:
+        findings.extend(asyncio_lint_file(f, repo_root=REPO_ROOT))
+    findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    return findings, len(files)
+
+
+def run_all(
+    paths: Optional[list] = None,
+    baseline_path: Optional[str] = None,
+    shape_audit: bool = True,
+) -> AnalysisResult:
+    """Lints + (optionally) the eval_shape audit, folded through the
+    baseline.  The CLI and the CI gate both call this."""
+    from .baseline import Baseline
+
+    findings, nfiles = run_lints(paths)
+    shape_entries = 0
+    errors: list = []
+    if shape_audit:
+        from .shape_audit import run_shape_audit
+
+        try:
+            shape_findings, shape_entries = run_shape_audit()
+            findings.extend(shape_findings)
+        except Exception as e:  # an analyzer crash is itself a failure
+            errors.append(f"shape audit crashed: {type(e).__name__}: {e}")
+
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "baseline.toml")
+    baseline = Baseline.load(baseline_path)
+    new, accepted = baseline.split(findings)
+    return AnalysisResult(
+        new=new,
+        baselined=accepted,
+        unused_baseline=baseline.unused(),
+        checked_files=nfiles,
+        shape_entries=shape_entries,
+        errors=errors,
+    )

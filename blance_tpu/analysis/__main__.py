@@ -1,0 +1,93 @@
+"""CLI: ``python -m blance_tpu.analysis [--ci] [paths...]``.
+
+Exit status is the contract CI consumes: 0 when every finding is either
+fixed or pinned in analysis/baseline.toml, nonzero when any NEW finding
+exists (or an analyzer itself crashed).  ``--ci`` is the full gate (AST
+lints + eval_shape audit); the default run skips the shape audit so the
+editor loop stays sub-second and jax-import-free (``--shape-audit``
+forces it back on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m blance_tpu.analysis",
+        description="blance_tpu static contract checks "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the blance_tpu "
+                         "package)")
+    ap.add_argument("--ci", action="store_true",
+                    help="the full CI gate: AST lints + the jax.eval_shape "
+                         "contract audit")
+    ap.add_argument("--shape-audit", action="store_true",
+                    help="run the eval_shape audit without the rest of "
+                         "the --ci strictness")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="allowlist file (default: "
+                         "blance_tpu/analysis/baseline.toml)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the allowlist")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON object)")
+    args = ap.parse_args(argv)
+
+    shape = args.ci or args.shape_audit
+    if shape:
+        # The sharded contracts want a multi-device mesh; force 8 virtual
+        # CPU devices BEFORE jax first imports (same trick as
+        # tests/conftest.py).  No-op when jax is already in.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    from . import run_all
+
+    result = run_all(
+        paths=args.paths or None,
+        baseline_path=("/dev/null" if args.no_baseline else args.baseline),
+        shape_audit=shape,
+    )
+
+    failed = bool(result.new) or bool(result.errors)
+    if args.json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in result.new],
+            "baselined": [
+                {**f.__dict__, "reason": reason}
+                for f, reason in result.baselined
+            ],
+            "unused_baseline": [e.render() for e in result.unused_baseline],
+            "checked_files": result.checked_files,
+            "shape_entries": result.shape_entries,
+            "errors": result.errors,
+            "pass": not failed,
+        }, indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        for e in result.errors:
+            print(f"ERROR: {e}")
+        for e in result.unused_baseline:
+            print(f"warning: stale baseline entry (matched nothing): "
+                  f"{e.render()}")
+        n_base = len(result.baselined)
+        print(f"blance_tpu.analysis: {result.checked_files} files, "
+              f"{result.shape_entries} shape contracts, "
+              f"{len(result.new)} new finding(s), {n_base} baselined"
+              + (" — FAIL" if failed else " — OK"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

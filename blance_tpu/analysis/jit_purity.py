@@ -1,0 +1,662 @@
+"""jit-purity lint: contracts for code that runs under a JAX trace.
+
+Everything inside a ``jax.jit`` / ``shard_map`` trace must be pure and
+shape-deterministic, or the warm-replan identity contract (plan/tensor.py:
+bit-identical warm vs cold solves, pinned tie-break bits) silently breaks:
+host nondeterminism bakes a one-off value into the compiled program,
+Python branching on traced values either crashes at trace time or forks
+the cache, and host coercions force device syncs mid-dispatch.
+
+The pass builds a cross-module call graph rooted at every function handed
+to ``jax.jit`` (decorator, ``jax.jit(f)``, ``partial(jax.jit, ...)``) or
+to a ``shard_map``-shaped wrapper (including through ``partial`` aliases,
+the idiom parallel/sharded.py uses), then walks the reachable set:
+
+- JIT001 (all reached code): host nondeterminism — ``time.*``,
+  ``random.*`` / ``numpy.random.*``, ``datetime.now``, ``os.urandom``,
+  ``uuid.*``.  A traced call bakes ONE sample into the compiled program;
+  every later call replays it.
+- JIT002 (trace roots, where static args are declared): Python ``if`` /
+  ``while`` branching directly on a traced parameter.  ``is None`` /
+  ``is not None`` tests are exempt (argument *presence* is static).
+- JIT003 (trace roots): ``float()`` / ``int()`` / ``bool()`` applied to a
+  traced parameter — a forced device sync (and a trace-time error under
+  jit).
+- JIT004 (all reached code): mutation of captured state — ``global`` /
+  ``nonlocal`` declarations, or mutating method calls
+  (append/extend/update/...) on names not bound in the local scope.
+  Traced mutations of captured Python state run ONCE, at trace time.
+- JIT005 (jit call sites): static-arg hygiene — ``static_argnames`` /
+  ``donate_argnames`` naming a parameter the wrapped function does not
+  have (jit raises only when the name is actually passed), and static
+  parameters whose declared default is an unhashable literal.
+
+Helpers reached from a root get JIT001/JIT004 only: without the root's
+``static_argnames`` there is no ground truth for which helper parameters
+are traced, and guessing would drown the signal in false positives (the
+analysis/baseline.toml workflow exists for the cases the pass cannot
+prove).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import Finding
+
+__all__ = ["JitPurityPass"]
+
+# fq-prefix -> why it is impure under a trace.
+_NONDET_PREFIXES = {
+    "time.time": "wall-clock read",
+    "time.time_ns": "wall-clock read",
+    "time.perf_counter": "wall-clock read",
+    "time.monotonic": "wall-clock read",
+    "time.sleep": "host sleep",
+    "random.": "host PRNG (use jax.random with an explicit key)",
+    "numpy.random.": "host PRNG (use jax.random with an explicit key)",
+    "datetime.datetime.now": "wall-clock read",
+    "datetime.date.today": "wall-clock read",
+    "os.urandom": "host entropy",
+    "uuid.": "host entropy",
+}
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "add", "discard", "popitem",
+}
+
+_COERCIONS = {"float", "int", "bool"}
+
+# Callables that wrap a function for tracing.  Matching is by resolved
+# dotted suffix so both ``jax.experimental.shard_map.shard_map`` and a
+# local ``_shard_map`` shim qualify.
+_TRACE_WRAPPER_SUFFIXES = ("shard_map",)
+
+
+@dataclass
+class FuncInfo:
+    module: str  # dotted module name
+    qualname: str  # "fn" or "Class.method"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    path: str  # repo-relative file path
+    params: list = field(default_factory=list)
+    # Params with literal defaults: when such a function becomes a trace
+    # root through shard_map/partial wrapping (no static_argnames to
+    # consult), branching on them is almost always the benign
+    # Python-default pattern — exempt from JIT002/JIT003.
+    defaulted: set = field(default_factory=set)
+    is_root: bool = False
+    statics: set = field(default_factory=set)  # declared static argnames
+
+    @property
+    def fq(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclass
+class ModuleInfo:
+    name: str  # dotted
+    path: str  # repo-relative
+    tree: ast.Module
+    is_pkg: bool = False  # an __init__.py (relative imports resolve
+    # against the package itself, not its parent)
+    imports: dict = field(default_factory=dict)  # local name -> fq prefix
+    functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
+    constants: dict = field(default_factory=dict)  # name -> literal value
+
+
+def _module_name(path: str, repo_root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), repo_root)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Attribute/Name chain -> "a.b.c", else None."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _literal_strings(node: ast.AST, constants: dict) -> Optional[list]:
+    """Extract a tuple/list of string literals, following one level of
+    module-constant indirection (the ``_WARM_STATICS`` idiom)."""
+    if isinstance(node, ast.Name) and node.id in constants:
+        val = constants[node.id]
+        if isinstance(val, (tuple, list)) and \
+                all(isinstance(x, str) for x in val):
+            return list(val)
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    return None
+
+
+class JitPurityPass:
+    """Whole-program pass: build the index, find roots, walk, lint."""
+
+    def __init__(self, files: list, repo_root: str) -> None:
+        self.repo_root = repo_root
+        self.modules: dict = {}
+        self.findings: list = []
+        for path in files:
+            try:
+                with open(path) as f:
+                    src = f.read()
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                rel = os.path.relpath(os.path.abspath(path), repo_root)
+                self.findings.append(Finding(
+                    rule="JIT000", path=rel.replace(os.sep, "/"),
+                    line=e.lineno or 0, symbol="",
+                    message=f"file does not parse: {e.msg}"))
+                continue
+            name = _module_name(path, repo_root)
+            rel = os.path.relpath(
+                os.path.abspath(path), repo_root).replace(os.sep, "/")
+            mi = ModuleInfo(name=name, path=rel, tree=tree,
+                            is_pkg=rel.endswith("__init__.py"))
+            self._index_module(mi)
+            self.modules[name] = mi
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_module(self, mi: ModuleInfo) -> None:
+        for node in mi.tree.body:
+            self._index_stmt(mi, node, prefix="")
+
+    def _index_stmt(self, mi: ModuleInfo, node: ast.stmt,
+                    prefix: str) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mi.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                if alias.asname:
+                    mi.imports[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = self._resolve_from(mi, node)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                mi.imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = f"{prefix}{node.name}"
+            args = node.args
+            params = ([a.arg for a in args.posonlyargs]
+                      + [a.arg for a in args.args]
+                      + [a.arg for a in args.kwonlyargs])
+            if args.vararg:
+                params.append(args.vararg.arg)
+            if args.kwarg:
+                params.append(args.kwarg.arg)
+            defaulted: set = set()
+            pos = [a.arg for a in args.posonlyargs] + \
+                [a.arg for a in args.args]
+            for name_, default in zip(pos[len(pos) - len(args.defaults):],
+                                      args.defaults):
+                if isinstance(default, ast.Constant):
+                    defaulted.add(name_)
+            for a, default in zip(args.kwonlyargs, args.kw_defaults):
+                if isinstance(default, ast.Constant):
+                    defaulted.add(a.arg)
+            mi.functions[qn] = FuncInfo(
+                module=mi.name, qualname=qn, node=node, path=mi.path,
+                params=params, defaulted=defaulted)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                self._index_stmt(mi, sub, prefix=f"{node.name}.")
+        elif isinstance(node, ast.Assign) and not prefix:
+            # Module-level literal constants (for static_argnames=NAME).
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                try:
+                    mi.constants[node.targets[0].id] = \
+                        ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    pass
+
+    def _resolve_from(self, mi: ModuleInfo, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = mi.name.split(".")
+        # level=1 is the CURRENT package: for a module that is its
+        # parent (drop the module's own name); for an __init__.py the
+        # module name IS the package.  Each extra level pops one more.
+        base = parts if mi.is_pkg else parts[:-1]
+        extra = node.level - 1
+        base = base[:len(base) - extra] if extra else base
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    # -- symbol resolution --------------------------------------------------
+
+    def _resolve(self, mi: ModuleInfo, dotted: str) -> str:
+        """Map a dotted local reference to its fully-qualified spelling."""
+        head, _, rest = dotted.partition(".")
+        fq_head = mi.imports.get(head, head)
+        return f"{fq_head}.{rest}" if rest else fq_head
+
+    def _lookup_function(self, mi: ModuleInfo, dotted: str):
+        """Resolve a reference to a FuncInfo in the analyzed set."""
+        # Same-module bare name (incl. Class.method chains).
+        if dotted in mi.functions:
+            return mi.functions[dotted]
+        return self._lookup_fq(self._resolve(mi, dotted))
+
+    def _lookup_fq(self, fq: str, depth: int = 0):
+        """Find a FuncInfo by fully-qualified name, chasing package
+        re-exports: ``pkg.helper`` where pkg/__init__.py does ``from
+        .impl import helper`` resolves to ``pkg.impl.helper`` — the
+        idiom this codebase uses for its public surfaces, which the
+        jit-purity call graph must see through (depth-bounded: a
+        re-export cycle must not hang the lint)."""
+        if depth > 8:
+            return None
+        # fq = "pkg.module.func" or "pkg.module.Class.func".
+        parts = fq.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            rest = ".".join(parts[cut:])
+            target = self.modules.get(mod)
+            if target is None:
+                continue
+            if rest in target.functions:
+                return target.functions[rest]
+            # Re-export chase: the symbol's head may be imported into
+            # ``mod`` from somewhere else in the analyzed set.
+            head, _, tail = rest.partition(".")
+            if head in target.imports:
+                re_fq = target.imports[head] + ("." + tail if tail else "")
+                found = self._lookup_fq(re_fq, depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    # -- root discovery -----------------------------------------------------
+
+    def _is_jit_ref(self, mi: ModuleInfo, node: ast.AST) -> bool:
+        dotted = _dotted(node)
+        if dotted is None:
+            return False
+        fq = self._resolve(mi, dotted)
+        return fq in ("jax.jit", "jax.pjit", "jax.jit.jit") or \
+            fq.endswith(".jit") and fq.startswith("jax")
+
+    def _is_trace_wrapper_ref(self, mi: ModuleInfo, node: ast.AST) -> bool:
+        dotted = _dotted(node)
+        if dotted is None:
+            return False
+        fq = self._resolve(mi, dotted)
+        # lstrip("_"): version-portability shims are conventionally the
+        # wrapped name with a leading underscore (parallel/sharded.py's
+        # ``_shard_map``).
+        leaf = fq.split(".")[-1].lstrip("_")
+        return any(leaf == s for s in _TRACE_WRAPPER_SUFFIXES)
+
+    def _mark_root(self, mi: ModuleInfo, func_ref: ast.AST,
+                   statics: set, aliases: dict) -> None:
+        """func_ref names (possibly via a partial alias) a function."""
+        target = None
+        if isinstance(func_ref, ast.Call):
+            # partial(f, ...) inline
+            inner = self._partial_target(mi, func_ref)
+            if inner is not None:
+                target = inner
+        else:
+            dotted = _dotted(func_ref)
+            if dotted is not None:
+                if dotted in aliases:
+                    dotted = aliases[dotted]
+                target = self._lookup_function(mi, dotted)
+        if target is not None:
+            target.is_root = True
+            target.statics |= statics
+
+    def _partial_target(self, mi: ModuleInfo, call: ast.Call):
+        """partial(f, ...) -> FuncInfo for f (one level)."""
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return None
+        if self._resolve(mi, dotted) != "functools.partial":
+            return None
+        if not call.args:
+            return None
+        inner = _dotted(call.args[0])
+        if inner is None:
+            return None
+        return self._lookup_function(mi, inner)
+
+    def _jit_statics(self, mi: ModuleInfo, call: ast.Call,
+                     wrapped) -> set:
+        """Parse static_argnames/donate_argnames off a jit(...) call,
+        emitting JIT005 findings against the wrapped function.  Only
+        static argnames are returned (donated args are still traced)."""
+        statics: set = set()
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnames", "donate_argnames"):
+                continue
+            names = _literal_strings(kw.value, mi.constants)
+            if names is None:
+                continue
+            if kw.arg == "static_argnames":
+                statics |= set(names)
+            if wrapped is not None:
+                missing = [n for n in names if n not in wrapped.params]
+                for n in missing:
+                    self.findings.append(Finding(
+                        rule="JIT005", path=mi.path, line=call.lineno,
+                        symbol=wrapped.qualname,
+                        message=f"{kw.arg} names {n!r} which is not a "
+                                f"parameter of {wrapped.qualname}() — jit "
+                                f"only raises when the name is passed, so "
+                                f"this typo hides until a call site uses "
+                                f"it"))
+        return statics
+
+    def _find_roots(self) -> None:
+        for mi in self.modules.values():
+            # partial aliases: var = partial(f, ...) / var = f, per module
+            # (function-local aliases are collected per function below).
+            aliases = self._collect_aliases(mi, mi.tree)
+            # 1) decorators
+            for fn in mi.functions.values():
+                for dec in fn.node.decorator_list:
+                    self._root_from_decorator(mi, fn, dec)
+            # 2) any jit(...) / shard_map-ish call anywhere
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_jit_ref(mi, node.func):
+                    wrapped = None
+                    if node.args:
+                        dotted = _dotted(node.args[0])
+                        if dotted is not None:
+                            dotted = aliases.get(dotted, dotted)
+                            wrapped = self._lookup_function(mi, dotted)
+                    statics = self._jit_statics(mi, node, wrapped)
+                    if wrapped is not None:
+                        wrapped.is_root = True
+                        wrapped.statics |= statics
+                elif isinstance(node.func, ast.Call):
+                    # partial(jax.jit, static_argnames=...)(f)
+                    inner = node.func
+                    if isinstance(inner, ast.Call) and inner.args and \
+                            self._is_jit_ref(mi, inner.args[0]) and \
+                            self._resolve(
+                                mi, _dotted(inner.func) or "") == \
+                            "functools.partial":
+                        wrapped = None
+                        if node.args:
+                            dotted = _dotted(node.args[0])
+                            if dotted is not None:
+                                dotted = aliases.get(dotted, dotted)
+                                wrapped = self._lookup_function(mi, dotted)
+                        statics = self._jit_statics(mi, inner, wrapped)
+                        if wrapped is not None:
+                            wrapped.is_root = True
+                            wrapped.statics |= statics
+                if self._is_trace_wrapper_ref(mi, node.func):
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        self._mark_root(mi, arg, set(), aliases)
+                # partial(_shard_map, body, ...): treat as a wrapper call
+                dotted = _dotted(node.func)
+                if dotted is not None and \
+                        self._resolve(mi, dotted) == "functools.partial" \
+                        and node.args and \
+                        self._is_trace_wrapper_ref(mi, node.args[0]):
+                    for arg in list(node.args[1:]) + \
+                            [kw.value for kw in node.keywords]:
+                        self._mark_root(mi, arg, set(), aliases)
+
+    def _root_from_decorator(self, mi: ModuleInfo, fn: FuncInfo,
+                             dec: ast.AST) -> None:
+        if self._is_jit_ref(mi, dec):  # @jax.jit
+            fn.is_root = True
+            return
+        if isinstance(dec, ast.Call):
+            if self._is_jit_ref(mi, dec.func):  # @jax.jit(...)
+                fn.is_root = True
+                fn.statics |= self._jit_statics(mi, dec, fn)
+            elif dec.args and self._is_jit_ref(mi, dec.args[0]) and \
+                    self._resolve(mi, _dotted(dec.func) or "") == \
+                    "functools.partial":  # @partial(jax.jit, ...)
+                fn.is_root = True
+                fn.statics |= self._jit_statics(mi, dec, fn)
+
+    def _collect_aliases(self, mi: ModuleInfo, tree: ast.AST) -> dict:
+        """name -> dotted function reference, for ``x = partial(f, ...)``
+        and ``x = f`` bindings."""
+        aliases: dict = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            val = node.value
+            if isinstance(val, ast.Call):
+                info = self._partial_target(mi, val)
+                if info is not None and info.module == mi.name:
+                    aliases[tgt.id] = info.qualname
+                elif info is not None:
+                    aliases[tgt.id] = info.fq
+            else:
+                dotted = _dotted(val)
+                if dotted is not None and \
+                        self._lookup_function(mi, dotted) is not None:
+                    aliases[tgt.id] = dotted
+        return aliases
+
+    # -- reachability -------------------------------------------------------
+
+    def _reachable(self) -> list:
+        roots = [fn for mi in self.modules.values()
+                 for fn in mi.functions.values() if fn.is_root]
+        seen = {fn.fq for fn in roots}
+        queue = list(roots)
+        while queue:
+            fn = queue.pop()
+            mi = self.modules[fn.module]
+            for node in ast.walk(fn.node):
+                dotted = None
+                if isinstance(node, ast.Call):
+                    dotted = _dotted(node.func)
+                    inner = self._partial_target(mi, node) \
+                        if dotted and self._resolve(mi, dotted) == \
+                        "functools.partial" else None
+                    if inner is not None and inner.fq not in seen:
+                        seen.add(inner.fq)
+                        queue.append(inner)
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load):
+                    dotted = node.id
+                if dotted is None:
+                    continue
+                callee = self._lookup_function(mi, dotted)
+                if callee is not None and callee.fq not in seen:
+                    seen.add(callee.fq)
+                    queue.append(callee)
+        return [self._by_fq(fq) for fq in sorted(seen)]
+
+    def _by_fq(self, fq: str):
+        for mi in self.modules.values():
+            for fn in mi.functions.values():
+                if fn.fq == fq:
+                    return fn
+        raise KeyError(fq)
+
+    # -- the lint -----------------------------------------------------------
+
+    def run(self) -> list:
+        self._find_roots()
+        for fn in self._reachable():
+            self._lint_function(fn)
+        return self.findings
+
+    def _emit(self, fn: FuncInfo, rule: str, line: int,
+              message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=fn.path, line=line, symbol=fn.qualname,
+            message=message))
+
+    def _local_names(self, fn: FuncInfo) -> set:
+        names = set(fn.params)
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        # Only true bindings: in ``x[k] = v`` / ``x.a = v``
+                        # the base name is a Load — x stays captured.
+                        if isinstance(sub, ast.Name) and \
+                                isinstance(sub.ctx, ast.Store):
+                            names.add(sub.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            elif isinstance(node, ast.comprehension):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            if isinstance(sub, ast.Name):
+                                names.add(sub.id)
+        return names
+
+    def _lint_function(self, fn: FuncInfo) -> None:
+        mi = self.modules[fn.module]
+        local = self._local_names(fn)
+        traced = set(fn.params) - fn.statics - fn.defaulted - \
+            {"self", "cls"}
+
+        for node in ast.walk(fn.node):
+            # JIT004: captured-state mutation
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                self._emit(fn, "JIT004", node.lineno,
+                           f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                           f"mutation inside traced code runs once, at "
+                           f"trace time — not per call")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+
+            dotted = _dotted(node.func)
+            if dotted is not None:
+                fq = self._resolve(mi, dotted)
+                # JIT001: host nondeterminism
+                for prefix, why in _NONDET_PREFIXES.items():
+                    hit = fq == prefix or (prefix.endswith(".") and
+                                           fq.startswith(prefix))
+                    if hit:
+                        self._emit(
+                            fn, "JIT001", node.lineno,
+                            f"call to {fq} under a jit trace: {why}; the "
+                            f"traced value is baked into the compiled "
+                            f"program and replayed on every call")
+                        break
+
+            # JIT004: mutating a captured name
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATING_METHODS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id not in local:
+                self._emit(
+                    fn, "JIT004", node.lineno,
+                    f"mutating call {node.func.value.id}."
+                    f"{node.func.attr}() targets captured state — under "
+                    f"a trace this runs once, at trace time")
+
+            # JIT003: device-sync coercion of a traced param (roots only)
+            if fn.is_root and isinstance(node.func, ast.Name) and \
+                    node.func.id in _COERCIONS and len(node.args) == 1:
+                arg = node.args[0]
+                names = {n.id for n in ast.walk(arg)
+                         if isinstance(n, ast.Name)}
+                # int(x.shape[0]) coerces a STATIC fact about x, not x.
+                under_attr = {
+                    n.id
+                    for sub in ast.walk(arg)
+                    if isinstance(sub, ast.Attribute)
+                    for n in ast.walk(sub.value)
+                    if isinstance(n, ast.Name)
+                }
+                hits = (names - under_attr) & traced
+                if hits:
+                    self._emit(
+                        fn, "JIT003", node.lineno,
+                        f"{node.func.id}() on traced value "
+                        f"{sorted(hits)[0]!r}: a forced device sync "
+                        f"(TracerConversionError under jit)")
+
+        # JIT002: branching on traced values (roots only)
+        if fn.is_root:
+            for node in ast.walk(fn.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                hits = self._traced_branch_names(node.test, traced)
+                if hits:
+                    self._emit(
+                        fn, "JIT002", node.lineno,
+                        f"Python {'if' if isinstance(node, ast.If) else 'while'} "
+                        f"on traced parameter {sorted(hits)[0]!r}: trace-"
+                        f"time branching forks the compile cache or "
+                        f"raises TracerBoolConversionError; use lax.cond/"
+                        f"jnp.where, or declare it static")
+
+    def _traced_branch_names(self, test: ast.AST, traced: set) -> set:
+        """Direct traced-parameter references in a branch test, minus
+        ``x is None`` / ``x is not None`` presence checks and attribute
+        accesses (``x.shape`` etc. are static under tracing)."""
+        exempt: set = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+                for sub in [node.left] + node.comparators:
+                    if isinstance(sub, ast.Name):
+                        exempt.add(sub.id)
+            elif isinstance(node, ast.Attribute):
+                # x.shape / x.ndim / x.dtype: static facts about x
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        exempt.add(sub.id)
+            elif isinstance(node, ast.Call):
+                fnode = node.func
+                if isinstance(fnode, ast.Name) and \
+                        fnode.id in ("isinstance", "len", "hasattr"):
+                    for a in node.args:
+                        for sub in ast.walk(a):
+                            if isinstance(sub, ast.Name):
+                                exempt.add(sub.id)
+        names = {n.id for n in ast.walk(test)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+        return (names & traced) - exempt

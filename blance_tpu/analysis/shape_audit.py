@@ -1,0 +1,484 @@
+"""Abstract shape audit: the solver's [P, S, N, R] contracts, enforced.
+
+The dense solver's shape conventions — assign[P, S, R] int32 with -1
+empties, carry.used[S, N] float32, prices[N], the bucketed-pad and
+shard_map layouts — live in docstrings and comments; nothing fails when
+an entry point drifts.  This module pins them in a declarative contract
+table checked with ``jax.eval_shape``: every public solver entry point is
+traced abstractly across a (P, S, N, R) x bucketing x carry matrix, so
+shape/dtype drift is caught in seconds with ZERO FLOPs and no device
+(GSPMD's insight in reverse: if the shapes are static contracts, check
+the contracts statically).
+
+Covered entry points (acceptance contract):
+
+- ``solve_dense``            — cold, carry-seeded, bucketed, bucketed+carry
+- ``solve_dense_converged``  — via ``_solve_dense_converged_impl`` (the
+  public wrapper adds host-side recording only), cold + carry
+- ``solve_dense_warm``       — via ``_warm_repair`` (the public wrapper
+  adds host gates around exactly this traced core)
+- sharded solve              — ``solve_dense`` under ``shard_map`` with
+  the partition axis sharded, the layout solve_dense_sharded builds
+- carry construction         — ``carry_from_assignment`` / ``_carry_used_jit``
+- ``encode_problem`` / ``decode_assignment`` — dense-encoding dtypes and
+  the decode round trip (tiny concrete problem; host-only, milliseconds)
+- ``bucket_size`` / ``pad_to`` — the bucketing algebra (monotone, >= x,
+  bounded overhead)
+
+Failures surface as findings: SHP001 (shape/dtype mismatch), SHP002
+(entry point raised under abstract evaluation), SHP003 (host-side
+contract violation).  Add a new entry point by appending to CONTRACTS —
+the table IS the documentation of the contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, NamedTuple
+
+from . import Finding
+
+__all__ = ["run_shape_audit", "CONTRACTS", "Dims"]
+
+_PATH = "blance_tpu/analysis/shape_audit.py"
+
+
+class Dims(NamedTuple):
+    """One point in the audit matrix."""
+
+    P: int
+    S: int
+    N: int
+    R: int
+    L: int = 1  # hierarchy levels (gids rows)
+
+    @property
+    def constraints(self) -> tuple:
+        # Full-depth slots for every state; max(constraints) == R by
+        # construction, the solver's own validity precondition.
+        return (self.R,) * self.S
+
+    @property
+    def rules(self) -> tuple:
+        # One (include, exclude) rule on the last state when there is
+        # more than one hierarchy level, else rule-free.
+        if self.L < 2 or self.S < 2:
+            return ((),) * self.S
+        return ((),) * (self.S - 1) + (((1, 0),),)
+
+
+@dataclass(frozen=True)
+class ShapeContract:
+    """One declarative entry-point contract.
+
+    ``build(d)`` returns (callable, args, kwargs) with array arguments as
+    ``jax.ShapeDtypeStruct``; ``expect(d)`` returns the expected output
+    as a pytree of (shape, dtype) pairs.  The runner eval_shapes the
+    callable and compares structurally.
+    """
+
+    entry: str  # reported entry-point name
+    variant: str  # "cold" / "carry" / "bucketed" / ...
+    build: Callable
+    expect: Callable
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _solver_args(d: Dims, jnp):
+    """The eight positional array args every solver entry shares."""
+    import numpy as np
+
+    return (
+        _sds((d.P, d.S, d.R), np.int32),  # prev
+        _sds((d.P,), np.float32),  # pweights
+        _sds((d.N,), np.float32),  # nweights
+        _sds((d.N,), np.bool_),  # valid
+        _sds((d.P, d.S), np.float32),  # stickiness
+        _sds((d.L, d.N), np.int32),  # gids
+        _sds((d.L, d.N), np.bool_),  # gid_valid
+    )
+
+
+def _expect_assign(d: Dims):
+    import numpy as np
+
+    return ((d.P, d.S, d.R), np.int32)
+
+
+def _expect_used(d: Dims):
+    import numpy as np
+
+    return ((d.S, d.N), np.float32)
+
+
+# -- builders ---------------------------------------------------------------
+
+
+def _build_solve_dense(d: Dims, carry: bool = False, bucketed: bool = False):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..plan.tensor import solve_dense
+
+    kwargs = {"constraints": d.constraints, "rules": d.rules,
+              "fused_score": "off"}
+    if carry:
+        kwargs["carry_used"] = _sds((d.S, d.N), np.float32)
+    if bucketed:
+        # Bucketed solves trace the REAL partition count as a scalar
+        # operand so intra-bucket drift cannot retrigger compilation.
+        kwargs["p_real"] = _sds((), np.float32)
+    return solve_dense, _solver_args(d, jnp), kwargs
+
+
+def _build_converged(d: Dims, carry: bool = False):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..plan.tensor import _solve_dense_converged_impl
+
+    kwargs = {"constraints": d.constraints, "rules": d.rules,
+              "fused_score": "off", "max_iterations": 4}
+    if carry:
+        kwargs["carry_used"] = _sds((d.S, d.N), np.float32)
+    return _solve_dense_converged_impl, _solver_args(d, jnp), kwargs
+
+
+def _build_warm(d: Dims):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..plan.tensor import _warm_repair
+
+    args = _solver_args(d, jnp) + (
+        _sds((d.P,), np.bool_),  # dirty
+        _sds((d.S, d.N), np.float32),  # carry_used
+    )
+    return _warm_repair, args, {"constraints": d.constraints,
+                                "rules": d.rules, "fused_score": "off"}
+
+
+def _build_carry_used(d: Dims):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..plan.tensor import _carry_used_jit
+
+    return _carry_used_jit, (
+        _sds((d.P, d.S, d.R), np.int32),
+        _sds((d.P,), np.float32),
+        _sds((d.N,), np.float32),
+    ), {}
+
+
+def _build_sharded(d: Dims):
+    """solve_dense under shard_map, the exact in/out layout
+    solve_dense_sharded builds (partition axis sharded, [N] vectors
+    replicated)."""
+    from functools import partial
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    from ..parallel.sharded import (
+        PARTITION_AXIS,
+        _build_checked,
+        _shard_map,
+        make_mesh,
+    )
+    from ..plan.tensor import solve_dense
+
+    n_dev = len(jax.devices())
+    shards = n_dev if d.P % n_dev == 0 else 1
+    mesh = make_mesh(shards)
+    shard = PartitionSpec(PARTITION_AXIS)
+    rep = PartitionSpec()
+    body = partial(solve_dense, constraints=d.constraints, rules=d.rules,
+                   axis_name=PARTITION_AXIS, fused_score="off")
+    sm = partial(_shard_map, body, mesh=mesh,
+                 in_specs=(shard, shard, rep, rep, shard, rep, rep),
+                 out_specs=shard)
+    # Same replication-checker policy as solve_dense_sharded: pre-vma
+    # JAX has no replication rule for the auction while_loop.
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    fn = _build_checked(sm, has_vma)
+    return fn, _solver_args(d, jnp), {}
+
+
+def _bucketed_dims(d: Dims) -> Dims:
+    from ..core.encode import bucket_size
+
+    return Dims(P=bucket_size(d.P), S=d.S, N=bucket_size(d.N), R=d.R,
+                L=d.L)
+
+
+# -- the table --------------------------------------------------------------
+
+# The audit matrix: small/typical/awkward sizes.  P values are multiples
+# of 8 so the sharded variant exercises a real multi-shard mesh on the 8
+# virtual CPU devices CI forces (a non-divisible P still audits, on a
+# 1-shard mesh).
+_MATRIX = (
+    Dims(P=8, S=1, N=5, R=1),
+    Dims(P=16, S=2, N=8, R=2, L=2),
+    Dims(P=24, S=3, N=9, R=3, L=2),
+)
+
+CONTRACTS: tuple = tuple(
+    [
+        ShapeContract(
+            entry="solve_dense", variant=f"cold@{d.P}x{d.N}",
+            build=(lambda d=d: _build_solve_dense(d)),
+            expect=(lambda d=d: _expect_assign(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense", variant=f"carry@{d.P}x{d.N}",
+            build=(lambda d=d: _build_solve_dense(d, carry=True)),
+            expect=(lambda d=d: _expect_assign(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense", variant=f"bucketed@{d.P}x{d.N}",
+            build=(lambda d=d: _build_solve_dense(
+                _bucketed_dims(d), bucketed=True)),
+            expect=(lambda d=d: _expect_assign(_bucketed_dims(d))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense", variant=f"bucketed+carry@{d.P}x{d.N}",
+            build=(lambda d=d: _build_solve_dense(
+                _bucketed_dims(d), carry=True, bucketed=True)),
+            expect=(lambda d=d: _expect_assign(_bucketed_dims(d))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense_converged", variant=f"cold@{d.P}x{d.N}",
+            build=(lambda d=d: _build_converged(d)),
+            # (assign, executed-sweep count)
+            expect=(lambda d=d: (_expect_assign(d), ((), "int32"))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense_converged", variant=f"carry@{d.P}x{d.N}",
+            build=(lambda d=d: _build_converged(d, carry=True)),
+            expect=(lambda d=d: (_expect_assign(d), ((), "int32"))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense_warm", variant=f"repair@{d.P}x{d.N}",
+            build=(lambda d=d: _build_warm(d)),
+            # (assign, new_used, accept flag)
+            expect=(lambda d=d: (_expect_assign(d), _expect_used(d),
+                                 ((), "bool"))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="carry_from_assignment", variant=f"used@{d.P}x{d.N}",
+            build=(lambda d=d: _build_carry_used(d)),
+            expect=(lambda d=d: _expect_used(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_dense_sharded", variant=f"1d@{d.P}x{d.N}",
+            build=(lambda d=d: _build_sharded(d)),
+            expect=(lambda d=d: _expect_assign(d)))
+        for d in _MATRIX
+    ]
+)
+
+
+# -- runner -----------------------------------------------------------------
+
+
+def _flatten_expect(exp):
+    """(shape, dtype) | tuple thereof -> flat list, mirroring how
+    eval_shape output tuples flatten."""
+    if isinstance(exp, tuple) and len(exp) == 2 and \
+            isinstance(exp[0], tuple) and \
+            all(isinstance(x, int) for x in exp[0]):
+        return [exp]
+    out = []
+    for e in exp:
+        out.extend(_flatten_expect(e))
+    return out
+
+
+def _check_one(contract: ShapeContract) -> list:
+    import numpy as np
+
+    import jax
+
+    findings: list = []
+    label = f"{contract.entry}[{contract.variant}]"
+    try:
+        fn, args, kwargs = contract.build()
+        # Static (non-array) kwargs ride a partial closure: eval_shape
+        # abstracts every operand it is handed, and a tuple/str static
+        # must stay a concrete Python value at trace time.
+        from functools import partial
+
+        statics = {k: v for k, v in kwargs.items()
+                   if not isinstance(v, jax.ShapeDtypeStruct)}
+        arrays = {k: v for k, v in kwargs.items()
+                  if isinstance(v, jax.ShapeDtypeStruct)}
+        out = jax.eval_shape(partial(fn, **statics), *args, **arrays)
+    except Exception as e:
+        first = (str(e).splitlines() or [""])[0][:200]
+        findings.append(Finding(
+            rule="SHP002", path=_PATH, line=0, symbol=label,
+            message=f"entry point raised under jax.eval_shape "
+                    f"({type(e).__name__}: {first})"))
+        return findings
+
+    got = jax.tree_util.tree_leaves(out)
+    want = _flatten_expect(contract.expect())
+    if len(got) != len(want):
+        findings.append(Finding(
+            rule="SHP001", path=_PATH, line=0, symbol=label,
+            message=f"output arity drift: expected {len(want)} arrays, "
+                    f"got {len(got)}"))
+        return findings
+    for i, (g, (shape, dtype)) in enumerate(zip(got, want)):
+        if tuple(g.shape) != tuple(shape) or \
+                np.dtype(g.dtype) != np.dtype(dtype):
+            findings.append(Finding(
+                rule="SHP001", path=_PATH, line=0, symbol=label,
+                message=f"output #{i} drifted: expected "
+                        f"{tuple(shape)} {np.dtype(dtype).name}, got "
+                        f"{tuple(g.shape)} {np.dtype(g.dtype).name}"))
+    return findings
+
+
+def _check_encode_decode() -> list:
+    """Concrete (tiny) encode/decode round trip: dense dtypes + map
+    shape.  Host-only, milliseconds."""
+    import numpy as np
+
+    from ..core.encode import decode_assignment, encode_problem
+    from ..core.types import Partition, PartitionModelState, PlanOptions
+
+    findings: list = []
+    label = "encode_problem/decode_assignment"
+    try:
+        model = {
+            "primary": PartitionModelState(priority=0, constraints=1),
+            "replica": PartitionModelState(priority=1, constraints=1),
+        }
+        nodes = ["a", "b", "c"]
+        pmap = {
+            "00": Partition("00", {"primary": ["a"], "replica": ["b"]}),
+            "01": Partition("01", {"primary": ["b"], "replica": ["c"]}),
+        }
+        problem = encode_problem(pmap, pmap, nodes, None, model,
+                                 PlanOptions())
+        expect = {
+            "prev": ((2, 2, 1), np.int32),
+            "constraints": ((2,), np.int32),
+            "partition_weights": ((2,), np.float32),
+            "node_weights": ((3,), np.float32),
+            "valid_node": ((3,), np.bool_),
+            "stickiness": ((2, 2), np.float32),
+            "gids": ((1, 3), np.int32),
+            "gid_valid": ((1, 3), np.bool_),
+        }
+        for field_name, (shape, dtype) in expect.items():
+            arr = getattr(problem, field_name)
+            if tuple(arr.shape) != shape or \
+                    np.dtype(arr.dtype) != np.dtype(dtype):
+                findings.append(Finding(
+                    rule="SHP001", path=_PATH, line=0, symbol=label,
+                    message=f"DenseProblem.{field_name} drifted: "
+                            f"expected {shape} {np.dtype(dtype).name}, "
+                            f"got {tuple(arr.shape)} {arr.dtype}"))
+        decoded, warns = decode_assignment(problem, problem.prev, pmap)
+        if set(decoded) != set(pmap) or warns:
+            findings.append(Finding(
+                rule="SHP001", path=_PATH, line=0, symbol=label,
+                message=f"decode(encode(m).prev) did not round-trip the "
+                        f"partition set cleanly (warnings: {warns})"))
+        elif decoded["00"].nodes_by_state != pmap["00"].nodes_by_state:
+            findings.append(Finding(
+                rule="SHP001", path=_PATH, line=0, symbol=label,
+                message="decode(encode(m).prev) changed placements"))
+    except Exception as e:
+        first = (str(e).splitlines() or [""])[0][:200]
+        findings.append(Finding(
+            rule="SHP002", path=_PATH, line=0, symbol=label,
+            message=f"encode/decode audit raised "
+                    f"({type(e).__name__}: {first})"))
+    return findings
+
+
+def _check_bucketing_algebra() -> list:
+    """bucket_size/pad_to host contracts: result >= x, monotone,
+    overhead bounded by 1/granularity, idempotent."""
+    import numpy as np
+
+    from ..core.encode import _BUCKET_GRANULARITY, bucket_size, pad_to
+
+    findings: list = []
+    label = "bucket_size/pad_to"
+    prev = 0
+    for x in list(range(1, 200)) + [255, 256, 257, 1000, 1007, 4096,
+                                    99_999, 100_001]:
+        b = bucket_size(x)
+        if b < x:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"bucket_size({x}) = {b} < x: padding would "
+                        f"TRUNCATE the axis"))
+            break
+        if bucket_size(b) != b:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"bucket_size not idempotent at {x}: "
+                        f"bucket_size({b}) = {bucket_size(b)}"))
+            break
+        if x > _BUCKET_GRANULARITY and \
+                (b - x) * _BUCKET_GRANULARITY > b:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"bucket_size({x}) = {b}: padding overhead "
+                        f"exceeds the 1/{_BUCKET_GRANULARITY} bound"))
+            break
+        if b < prev:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"bucket_size not monotone at {x}"))
+            break
+        prev = b
+    arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+    padded = pad_to(arr, 1, 5, -1)
+    if padded.shape != (2, 5) or not (padded[:, 3:] == -1).all() or \
+            not (padded[:, :3] == arr).all():
+        findings.append(Finding(
+            rule="SHP003", path=_PATH, line=0, symbol=label,
+            message="pad_to contract violated (shape/fill/prefix)"))
+    if pad_to(arr, 1, 2, -1) is not arr:
+        findings.append(Finding(
+            rule="SHP003", path=_PATH, line=0, symbol=label,
+            message="pad_to must be a no-op when already long enough"))
+    return findings
+
+
+def run_shape_audit() -> tuple:
+    """Run the whole table.  Returns (findings, entries_checked)."""
+    findings: list = []
+    for contract in CONTRACTS:
+        findings.extend(_check_one(contract))
+    findings.extend(_check_encode_decode())
+    findings.extend(_check_bucketing_algebra())
+    return findings, len(CONTRACTS) + 2

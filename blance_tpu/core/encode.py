@@ -60,7 +60,8 @@ def bucket_size(x: int, granularity: int = _BUCKET_GRANULARITY) -> int:
     return -(-x // step) * step
 
 
-def pad_to(arr: np.ndarray, axis: int, target: int, fill) -> np.ndarray:
+def pad_to(arr: np.ndarray, axis: int, target: int,
+           fill: float | int | bool) -> np.ndarray:
     """Pad ``arr`` along ``axis`` up to ``target`` entries with ``fill``;
     no-op when already that long.  The one padding spelling shared by
     shape bucketing here and mesh-divisibility padding in

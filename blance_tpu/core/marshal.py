@@ -31,7 +31,7 @@ def _source_path() -> str:
         "native", "marshal.c")
 
 
-def get():
+def get() -> object:
     """The extension module, or None when unavailable."""
     global _MOD, _FAILED
     if _MOD is not None or _FAILED:

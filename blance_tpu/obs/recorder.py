@@ -41,7 +41,11 @@ import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import TYPE_CHECKING, Iterator, Optional
+
+if TYPE_CHECKING:  # annotation-only
+    from ..utils.trace import PhaseTimer
+    from .sinks import Sink
 
 __all__ = [
     "Span",
@@ -134,11 +138,11 @@ class Recorder:
 
     # -- spans ---------------------------------------------------------------
 
-    def add_sink(self, sink) -> None:
+    def add_sink(self, sink: "Sink") -> None:
         with self._lock:
             self.sinks.append(sink)
 
-    def remove_sink(self, sink) -> None:
+    def remove_sink(self, sink: "Sink") -> None:
         with self._lock:
             if sink in self.sinks:
                 self.sinks.remove(sink)
@@ -185,7 +189,7 @@ class Recorder:
         self._finish(sp)
         return sp
 
-    def set_attr(self, key: str, value) -> None:
+    def set_attr(self, key: str, value: object) -> None:
         """Attach an attribute to the current span; no-op outside any."""
         sp = self._current.get()
         if sp is not None:
@@ -295,8 +299,9 @@ def use_recorder(recorder: Recorder) -> Iterator[Recorder]:
 
 
 @contextlib.contextmanager
-def phase_span(name: str, timer=None, phase: Optional[str] = None,
-               **attrs) -> Iterator[Span]:
+def phase_span(name: str, timer: Optional["PhaseTimer"] = None,
+               phase: Optional[str] = None,
+               **attrs: object) -> Iterator[Span]:
     """Recorder span that ALSO accumulates into a PhaseTimer.
 
     The instrumented pipeline names spans hierarchically ("plan.encode")

@@ -14,11 +14,21 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import IO, Optional, Union
+from typing import IO, Optional, Protocol, Union, runtime_checkable
 
 from .recorder import Span
 
-__all__ = ["InMemorySink", "JsonlSink", "span_to_dict"]
+__all__ = ["Sink", "InMemorySink", "JsonlSink", "span_to_dict"]
+
+
+@runtime_checkable
+class Sink(Protocol):
+    """The structural contract a sink implements (duck-typed; this
+    Protocol names it for annotations and the static tier)."""
+
+    def span(self, sp: Span) -> None: ...
+
+    def close(self) -> None: ...
 
 
 def span_to_dict(sp: Span, t0: float = 0.0) -> dict:

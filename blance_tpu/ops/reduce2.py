@@ -169,5 +169,5 @@ def pallas_available() -> bool:
     """True when the Pallas path should be used (a real TPU backend)."""
     try:
         return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    except RuntimeError:  # pragma: no cover — backend init failed: no
+        return False      # usable device at all, so no Pallas either

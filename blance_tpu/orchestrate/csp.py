@@ -164,10 +164,10 @@ class Chan:
             if waiter.token.claim():
                 waiter.future.set_result((waiter.index, ChanClosed()))
 
-    def __aiter__(self):
+    def __aiter__(self) -> "Chan":
         return self
 
-    async def __anext__(self):
+    async def __anext__(self) -> Any:
         value, ok = await self.get()
         if not ok:
             raise StopAsyncIteration

@@ -36,7 +36,7 @@ import asyncio
 import hashlib
 import inspect
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 __all__ = ["FaultInjected", "NodeFaults", "FaultPlan"]
 
@@ -115,7 +115,7 @@ class FaultPlan:
     def _bump(self, kind: str) -> None:
         self.injected[kind] = self.injected.get(kind, 0) + 1
 
-    def wrap(self, assign):
+    def wrap(self, assign: Callable[..., object]) -> Callable[..., object]:
         """Wrap a sync-or-async assign_partitions callback.  The wrapper
         consults the schedule per batch (a batch faults when ANY of its
         partitions' next attempts is scripted to fault — hang beats fail

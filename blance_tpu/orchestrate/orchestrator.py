@@ -184,7 +184,7 @@ class OrchestratorProgress:
     """Monotonic progress counters + errors, streamed as whole snapshots
     (orchestrate.go:119-141)."""
 
-    errors: list = field(default_factory=list)
+    errors: list[Exception] = field(default_factory=list)
 
     tot_stop: int = 0
     tot_pause_new_assignments: int = 0
@@ -257,7 +257,7 @@ class NextMoves:
 
     __slots__ = ("partition", "next", "moves", "next_done_ch", "failed_at")
 
-    def __init__(self, partition: str, moves: list) -> None:
+    def __init__(self, partition: str, moves: list[PartitionMove]) -> None:
         self.partition = partition
         self.next = 0  # index of the next available move
         self.moves = moves
@@ -372,7 +372,8 @@ class Orchestrator:
             self._pause_ch.close()
             self._pause_ch = None
 
-    def visit_next_moves(self, cb) -> None:
+    def visit_next_moves(
+            self, cb: Callable[[dict[str, NextMoves]], None]) -> None:
         """Read access to the live move cursors, e.g. for UIs
         (orchestrate.go:395-399)."""
         cb(self._map_partition_to_next_moves)
@@ -410,21 +411,45 @@ class Orchestrator:
 
     # -- internals -----------------------------------------------------------
 
+    def _spawn(self, coro: Awaitable[object]) -> "asyncio.Task[object]":
+        """Spawn an orchestration task with its exception OBSERVED.
+
+        A bare ``ensure_future`` whose result nobody awaits is the
+        asyncio bug class the static suite flags (analysis/asyncio_lint
+        ASY101): the Task can be garbage-collected mid-run, and an
+        escaped exception surfaces only as a destructor warning long
+        after the orchestration wedged.  Every mover/supplier/feeder
+        goes through here instead: the task is retained in
+        ``self._tasks`` (pruned as tasks finish, so thousands of feeder
+        rounds don't accumulate) and a done-callback retrieves its
+        exception — escaped ones (loop bugs; app errors are converted to
+        move errors before they can escape) are surfaced as a
+        UserWarning plus an ``orchestrate.task_exceptions`` counter
+        instead of vanishing."""
+        task = asyncio.ensure_future(coro)
+        self._tasks = [t for t in self._tasks if not t.done()]
+        self._tasks.append(task)
+
+        def _observe(t: "asyncio.Task") -> None:
+            if t.cancelled():
+                return
+            exc = t.exception()  # marks the exception retrieved
+            if exc is not None:
+                self._rec.count("orchestrate.task_exceptions")
+                _warnings.warn(
+                    f"blance_tpu orchestrate: internal task died with "
+                    f"{type(exc).__name__}: {exc}", UserWarning)
+
+        task.add_done_callback(_observe)
+        return task
+
     def _start(self, stop_ch: Chan) -> None:
         run_mover_done_ch = Chan()
         for node in self.nodes_all:
-            self._tasks.append(
-                asyncio.ensure_future(
-                    self._run_mover(stop_ch, run_mover_done_ch, node)
-                )
-            )
-        self._tasks.append(
-            asyncio.ensure_future(
-                self._run_supply_moves(stop_ch, run_mover_done_ch)
-            )
-        )
+            self._spawn(self._run_mover(stop_ch, run_mover_done_ch, node))
+        self._spawn(self._run_supply_moves(stop_ch, run_mover_done_ch))
 
-    async def _update_progress(self, mutate) -> None:
+    async def _update_progress(self, mutate: Callable[[], None]) -> None:
         """Apply a counter mutation and blocking-send a snapshot
         (orchestrate.go:735-745)."""
         mutate()
@@ -441,7 +466,10 @@ class Orchestrator:
         counter-only progress event goes through."""
         await self._update_progress(lambda: self._bump_sync(*names))
 
-    async def _call_assign(self, stop_ch, node, partitions, states, ops):
+    async def _call_assign(
+        self, stop_ch: Chan, node: str, partitions: list[str],
+        states: list[str], ops: list[str],
+    ) -> Optional[Exception]:
         """Invoke the app callback (sync or async); exceptions become the
         move's error.  With ``move_timeout_s`` set, an ASYNC callback
         that outlives the deadline is cancelled and the attempt fails
@@ -497,8 +525,10 @@ class Orchestrator:
             stop_ch._gc()
         return stop_ch.closed
 
-    async def _exec_with_retries(self, stop_ch, node, partitions, states,
-                                 ops):
+    async def _exec_with_retries(
+        self, stop_ch: Chan, node: str, partitions: list[str],
+        states: list[str], ops: list[str],
+    ) -> tuple[Optional[Exception], int]:
         """One batch execution under the fault-tolerance policy: bounded
         retries with exponential backoff + deterministic jitter, per-
         attempt health reporting.  Returns (err, attempts); legacy mode
@@ -535,7 +565,8 @@ class Orchestrator:
         err = await self._mover_loop(stop_ch, self._map_node_to_req_ch[node], node)
         await done_ch.put(err)
 
-    async def _mover_loop(self, stop_ch: Chan, req_ch: Chan, node: str):
+    async def _mover_loop(self, stop_ch: Chan, req_ch: Chan,
+                          node: str) -> Optional[Exception]:
         """Receive batched move requests and run the assign callback
         synchronously per batch (orchestrate.go:426-480).
 
@@ -624,8 +655,10 @@ class Orchestrator:
                     await select((GET, stop_ch), (PUT, req.done_ch, err))
                 req.done_ch.close()
 
-    async def _record_batch_failure(self, node, partition_moves, attempts,
-                                    cause) -> MoveFailure:
+    async def _record_batch_failure(
+        self, node: str, partition_moves: list[PartitionMove],
+        attempts: int, cause: object,
+    ) -> MoveFailure:
         """Fold one failed batch into the structured failure history:
         one MoveFailure per partition move, appended to ``failures`` AND
         ``progress.errors`` (snapshot emitted once for the batch).
@@ -738,8 +771,9 @@ class Orchestrator:
             for node, next_moves_arr in feed_nodes.items():
                 picked = self._filter_next_plausible_moves_for_node(
                     node, next_moves_arr)
-                self._tasks.append(asyncio.ensure_future(self._run_supply_move(
-                    stop_ch, node, picked, broadcast_stop_ch, broadcast_done_ch)))
+                self._spawn(self._run_supply_move(
+                    stop_ch, node, picked, broadcast_stop_ch,
+                    broadcast_done_ch))
 
             await self._bump("tot_run_supply_moves_feeding")
 

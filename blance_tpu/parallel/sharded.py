@@ -134,7 +134,8 @@ def make_mesh_2d(
     return Mesh(arr, (PARTITION_AXIS, NODE_AXIS))
 
 
-def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+def pad_partitions(arr: np.ndarray, multiple: int,
+                   fill: float | int | bool) -> np.ndarray:
     """Pad axis 0 to a multiple of the mesh size.
 
     Padding rows use weight 0 so they bid without consuming capacity or
@@ -144,7 +145,8 @@ def pad_partitions(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
     return pad_to(arr, 0, p + (-p) % multiple, fill)
 
 
-def pad_nodes(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+def pad_nodes(arr: np.ndarray, multiple: int,
+              fill: float | int | bool) -> np.ndarray:
     """Pad the trailing (node) axis to a multiple of the node-shard count.
 
     Padding nodes are invalid (valid=False ⇒ zero capacity, +INF score,
@@ -313,10 +315,17 @@ def solve_dense_sharded(
                        out_specs=(shard, rep, rep))
         fn_w = _build_checked(sm_w, checked_ok)
         with rec.span("plan.solve.attempt", warm=True, sharded=True):
-            out, new_used, ok = fn_w(
-                *dev_args,
-                device_put(jnp.asarray(dirty_p), shard),
-                device_put(jnp.asarray(cu), rep))
+            # transfer_guard allowlist: dispatching a fresh shard_map
+            # executable uploads its jaxpr closure constants as
+            # replicated buffers — an IMPLICIT transfer by jax's
+            # classification, but intrinsic to compilation, not an
+            # accidental per-call sync.  All operands above are explicit
+            # device_puts; only the dispatch itself is exempted.
+            with jax.transfer_guard("allow"):
+                out, new_used, ok = fn_w(
+                    *dev_args,
+                    device_put(jnp.asarray(dirty_p), shard),
+                    device_put(jnp.asarray(cu), rep))
             accepted = bool(ok)
         if accepted:
             _record_sweeps(1)
@@ -350,7 +359,10 @@ def solve_dense_sharded(
                  in_specs=(shard, shard, rep, rep, shard, rep, rep),
                  out_specs=shard)
     fn = _build_checked(sm, checked_ok)
-    assign = np.asarray(fn(*dev_args))[:p_orig]
+    # Same dispatch-time constant-upload exemption as the warm path.
+    with jax.transfer_guard("allow"):
+        out = fn(*dev_args)
+    assign = np.asarray(out)[:p_orig]
     if return_carry:
         return assign, carry_from_assignment(
             assign, np.asarray(pweights, np.float32),

@@ -14,11 +14,19 @@
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
-from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from ..core.types import (
+    HierarchyRules,
+    PartitionMap,
+    PartitionModel,
+    PlanOptions,
+)
 from ..obs import get_recorder
 from .greedy import plan_next_map_greedy
+
+if TYPE_CHECKING:  # annotation-only
+    from ..utils.trace import PhaseTimer
 
 __all__ = ["plan_next_map", "plan_next_map_legacy"]
 
@@ -39,7 +47,7 @@ def plan_next_map(
     model: Optional[PartitionModel] = None,
     opts: Optional[PlanOptions] = None,
     backend: str = "greedy",
-    timer=None,
+    timer: Optional["PhaseTimer"] = None,
 ) -> tuple[PartitionMap, dict[str, list[str]]]:
     """Compute the next balanced partition map.
 
@@ -94,7 +102,7 @@ def plan_next_map_legacy(
     state_stickiness: Optional[dict[str, int]] = None,
     node_weights: Optional[dict[str, int]] = None,
     node_hierarchy: Optional[dict[str, str]] = None,
-    hierarchy_rules=None,
+    hierarchy_rules: Optional["HierarchyRules"] = None,
     backend: str = "greedy",
 ) -> tuple[PartitionMap, dict[str, list[str]]]:
     """Positional-options compatibility shim mirroring the reference's

@@ -26,7 +26,7 @@ Semantic notes preserved on purpose (each cites the reference):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Iterable, Optional
 
 import numpy as np
 
@@ -91,7 +91,7 @@ def _adjust_state_node_counts(
 def _remove_nodes_from_nodes_by_state(
     nodes_by_state: dict[str, list[str]],
     remove: list[str],
-    on_removed=None,
+    on_removed: Optional[Callable[[str, str, list[str]], None]] = None,
 ) -> dict[str, list[str]]:
     """Copy with nodes removed; callback sees actually-removed nodes
     (plan.go:408-421)."""
@@ -171,7 +171,8 @@ def default_node_score(ctx: NodeScoreContext, node: str) -> float:
     return r - current
 
 
-def _sort_nodes(ctx: NodeScoreContext, nodes: list[str], scorer) -> list[str]:
+def _sort_nodes(ctx: NodeScoreContext, nodes: list[str],
+                scorer: Callable[[NodeScoreContext, str], float]) -> list[str]:
     """Sort by score ASC, ties by node position in nodes_all (plan.go:617-628)."""
     return sorted(
         nodes,
@@ -201,7 +202,7 @@ def _partition_name_key(name: str) -> str:
     return f"{n:>10d}"
 
 
-def sorted_by_partition_name(names) -> list[str]:
+def sorted_by_partition_name(names: "Iterable[str]") -> list[str]:
     """Sort names by (zero-padded-numeric-else-raw key, name) — the static
     component of the reference's partition order (plan.go:524-528).
 

@@ -35,7 +35,7 @@ docs/OBSERVABILITY.md for the plan.solve.warm/carry_* signals.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
@@ -46,6 +46,12 @@ from ..core.types import (
     PartitionModel,
     PlanOptions,
 )
+
+if TYPE_CHECKING:  # annotation-only: keep jax imports lazy at runtime
+    from jax.sharding import Mesh
+
+    from ..core.encode import DenseProblem
+    from .tensor import SolveCarry
 
 __all__ = ["PlannerSession"]
 
@@ -70,7 +76,7 @@ class PlannerSession:
         nodes: list[str],
         partitions: list[str],
         opts: Optional[PlanOptions] = None,
-        mesh=None,
+        mesh: Optional["Mesh"] = None,
     ) -> None:
         self.model = model
         self.opts = opts or PlanOptions()
@@ -109,7 +115,7 @@ class PlannerSession:
         self._node_index = {n: i for i, n in enumerate(self._problem.nodes)}
 
     @property
-    def problem(self):
+    def problem(self) -> "DenseProblem":
         """The encoded statics (DenseProblem).
 
         ``problem.prev`` is only the encode-time seed (all -1, or the last
@@ -225,7 +231,8 @@ class PlannerSession:
         self._pending_carry = self._pad_one_carry(self._pending_carry, n)
 
     @staticmethod
-    def _pad_one_carry(carry, n: int):
+    def _pad_one_carry(carry: Optional["SolveCarry"],
+                       n: int) -> Optional["SolveCarry"]:
         if carry is None:
             return None
         used = np.asarray(carry.used)
@@ -274,7 +281,8 @@ class PlannerSession:
                 d |= (self.current[:, si, :k] < 0).any(axis=1)
         return d
 
-    def _capacity_shrank(self, carry, dirty: np.ndarray) -> bool:
+    def _capacity_shrank(self, carry: "SolveCarry",
+                         dirty: np.ndarray) -> bool:
         """True when some node's clean-row held weight exceeds its new
         per-state capacity rail — the pin pass would then trim (displace)
         holders OUTSIDE the dirty mask, so a warm repair cannot be
@@ -480,7 +488,10 @@ class PlannerSession:
         self._pending_carry = new_carry
         return assign
 
-    def _warm_solve(self, carry, dirty, constraints, rules, mode):
+    def _warm_solve(
+        self, carry: "SolveCarry", dirty: np.ndarray, constraints: tuple,
+        rules: tuple, mode: str,
+    ) -> tuple[Optional[np.ndarray], Optional["SolveCarry"]]:
         """One warm repair attempt; (None, None) on decline/failure."""
         from . import tensor as _tensor
         from ..obs import get_recorder
@@ -522,7 +533,8 @@ class PlannerSession:
             get_recorder().count("plan.solve.warm_fallback")
             return None, None
 
-    def _audit_gate(self, prob, assign) -> bool:
+    def _audit_gate(self, prob: "DenseProblem",
+                    assign: np.ndarray) -> bool:
         """True when the audit policy is active AND finds violations —
         the warm path's fall-back-to-cold condition.  Respects
         opts.validate_assignment exactly like maybe_validate (None =

@@ -124,7 +124,12 @@ def _device_hbm_bytes() -> int:
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return limit
-    except Exception:
+    except (RuntimeError, IndexError, AttributeError, TypeError,
+            ValueError):
+        # The documented "runtime does not report" shapes: backend init
+        # failure, no devices, a backend without memory_stats, or a
+        # stats dict with a non-numeric limit.  Anything else (bugs,
+        # KeyboardInterrupt) propagates.
         pass
     return 16 * 2 ** 30
 
@@ -192,7 +197,8 @@ class SolveCarry(NamedTuple):
     used: jnp.ndarray
 
 
-def _used_by_state(assign, pweights, n, s, axis_name=None):
+def _used_by_state(assign: jnp.ndarray, pweights: jnp.ndarray, n: int,
+                   s: int, axis_name: Optional[str] = None) -> jnp.ndarray:
     """[S, N] per-state weighted fill — the carry's ``used`` table.
 
     One :func:`_scatter_counts` per state followed by a psum, exactly
@@ -204,14 +210,16 @@ def _used_by_state(assign, pweights, n, s, axis_name=None):
 
 
 @jax.jit
-def _carry_used_jit(assign, pweights, nweights):
+def _carry_used_jit(assign: jnp.ndarray, pweights: jnp.ndarray,
+                    nweights: jnp.ndarray) -> jnp.ndarray:
     """Single-device spelling of :func:`_used_by_state` (for building a
     carry from a host-side assignment, e.g. after a cold solve)."""
     return _used_by_state(
         assign, pweights, nweights.shape[0], assign.shape[1])
 
 
-def carry_from_assignment(assign, pweights, nweights) -> SolveCarry:
+def carry_from_assignment(assign: jnp.ndarray, pweights: jnp.ndarray,
+                          nweights: jnp.ndarray) -> SolveCarry:
     """Package a converged assignment as a :class:`SolveCarry`.
 
     Use after any cold solve whose output will seed future delta
@@ -309,7 +317,7 @@ def _hier_penalty(
     return jnp.where(any_anchor[:, None], pen, 0.0)
 
 
-def _psum(x, axis_name):
+def _psum(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
     return lax.psum(x, axis_name) if axis_name else x
 
 
@@ -1393,7 +1401,7 @@ def _solve_dense_converged_impl(
     return out, it
 
 
-def _record_sweeps(sweeps) -> None:
+def _record_sweeps(sweeps: object) -> None:
     """Publish a converged solve's pass count to the obs Recorder.
 
     Silently skipped when ``sweeps`` is a tracer (solve_dense_converged
@@ -1403,7 +1411,10 @@ def _record_sweeps(sweeps) -> None:
         return
     try:
         n = int(sweeps)
-    except Exception:
+    except (TypeError, ValueError):
+        # A non-scalar/non-numeric sweeps value (an exotic tracer the
+        # isinstance above missed, an aborted transfer) — recording is
+        # best-effort, correctness errors propagate elsewhere.
         return
     rec = get_recorder()
     rec.count("plan.solve.calls")
@@ -2261,8 +2272,12 @@ def plan_next_map_tpu(
             # contract for zero benefit on the default path.  (This is
             # also why bucketed output is contract-equivalent to the
             # unbucketed solve, not bit-identical.)
-            p_real=(np.float32(problem.P) if opts.shape_bucketing
-                    else None),
+            # device_put: the traced scalar must reach the device as an
+            # EXPLICIT transfer (a bare np scalar operand rides the
+            # eager convert primitive, which the tier-1 transfer-guard
+            # fixture in tests/conftest.py rejects as an implicit sync).
+            p_real=(jax.device_put(np.float32(problem.P))
+                    if opts.shape_bucketing else None),
         )
     assign = assign[:problem.P]  # bucketing pad rows are not real work
     maybe_validate(problem, assign, opts.validate_assignment,

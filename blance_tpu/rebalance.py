@@ -30,7 +30,7 @@ import json
 import os
 import tempfile
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from .core.types import (
     Partition,
@@ -51,6 +51,9 @@ from .orchestrate.orchestrator import (
 )
 from .plan.api import plan_next_map
 from .utils.trace import PhaseTimer
+
+if TYPE_CHECKING:  # annotation-only
+    from .plan.session import PlannerSession
 
 __all__ = [
     "RebalanceResult",
@@ -134,14 +137,17 @@ def load_partition_map(path: str) -> PartitionMap:
         return partition_map_from_json(json.load(f))
 
 
-def _session_matches(session, cur: PartitionMap) -> bool:
+def _session_matches(session: "PlannerSession", cur: PartitionMap) -> bool:
     """True when the session's adopted current state already IS ``cur``
     — then load_map (which invalidates the warm carry) can be skipped
     and a repeat rebalance through the same session warm-starts its
     primary plan off the carry the previous call promoted."""
     try:
         current, _warns = session.to_map("current")
-    except Exception:
+    except ValueError:
+        # to_map's documented failure (nothing adopted yet / unknown
+        # which): no adopted state means no match.  Anything else is a
+        # real bug and must surface, not silently force a cold replan.
         return False
     return current == cur
 
@@ -165,7 +171,7 @@ async def rebalance_async(
     nodes_all: list[str],
     nodes_to_remove: Optional[list[str]],
     nodes_to_add: Optional[list[str]],
-    assign_partitions,
+    assign_partitions: Callable[..., object],
     *,
     plan_options: Optional[PlanOptions] = None,
     orchestrator_options: Optional[OrchestratorOptions] = None,

@@ -110,7 +110,8 @@ def vis_maps(case: VisCase) -> tuple[PartitionMap, PartitionMap]:
 
 def _weighted_state_spread(
     pmap: PartitionMap, model: PartitionModel, nodes: list[str],
-    node_weights, partition_weights,
+    node_weights: Optional[dict[str, int]],
+    partition_weights: Optional[dict[str, int]],
 ) -> dict[str, float]:
     """Per state: max-min of partition-weighted load / node weight over
     ``nodes`` — the quantity the planners balance (plan.go:94)."""

@@ -92,8 +92,22 @@ def device_profile(log_dir: Optional[str]) -> Iterator[None]:
 
         trace_cm = jax.profiler.trace(log_dir)
         trace_cm.__enter__()
-    except Exception:
+    except (ImportError, RuntimeError, OSError):
+        # The documented no-op cases: jax absent, profiler unavailable /
+        # already active, log dir unwritable.  Profiling stays
+        # best-effort for these.
         trace_cm = None
+    except Exception as e:
+        # Anything else is unexpected — still best-effort (a profiler
+        # bug must not kill the profiled run), but say so instead of
+        # silently dropping the trace.
+        trace_cm = None
+        import warnings
+
+        warnings.warn(
+            f"device_profile: unexpected profiler failure "
+            f"({type(e).__name__}: {e}); continuing without a device "
+            f"trace", RuntimeWarning, stacklevel=3)
     try:
         yield
     finally:

@@ -25,6 +25,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import logging  # noqa: E402
+
 import pytest  # noqa: E402
 
 # The fast-CI tier (pytest -m smoke): every data-model / moves / planner
@@ -57,6 +59,117 @@ def pytest_collection_modifyitems(config, items):
         module = item.nodeid.split("::", 1)[0].rsplit("/", 1)[-1]
         if module.removesuffix(".py") in SMOKE_MODULES:
             item.add_marker(pytest.mark.smoke)
+
+
+# -- static-contract fixtures (docs/STATIC_ANALYSIS.md) ---------------------
+
+# Transfer-guard allowlist contract: the pure solver paths convert at the
+# boundaries EXPLICITLY (jnp.asarray in / np.asarray-device_get out), so
+# under jax.transfer_guard("disallow") — which blocks only IMPLICIT
+# transfers — an accidental host sync inside solve_dense /
+# solve_dense_warm (a raw numpy operand reaching a jit call, a silent
+# device round-trip between dispatches) fails the test instead of
+# silently eating a sync.  The known host prechecks (the O(N) carry
+# routing check in PlannerSession._capacity_shrank, the tier-band guard)
+# already read through explicit np.asarray, which the guard permits.
+_TRANSFER_GUARD_MODULES = {"test_warm_replan"}
+
+
+@pytest.fixture(autouse=True)
+def _solver_transfer_guard(request):
+    """Autouse for the pure-solver suites: any implicit host<->device
+    transfer inside the solve is a test failure.  Opt in elsewhere with
+    the named ``no_implicit_transfers`` fixture."""
+    module = request.node.nodeid.split("::", 1)[0] \
+        .rsplit("/", 1)[-1].removesuffix(".py")
+    if module not in _TRANSFER_GUARD_MODULES:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@pytest.fixture
+def no_implicit_transfers():
+    """Opt-in: run one test under jax.transfer_guard("disallow")."""
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+# Recompile-count regression budgets (the PR-2 shape-bucketing
+# guarantee): per module, the maximum number of XLA compilations the
+# suite may trigger when run standalone (a shared-process run prewarms
+# caches and compiles strictly less).  Counted from jax's own
+# log_compiles stream, so shard_map-level compiles are included.
+# Calibrated standalone values, with ~30% headroom for jax-internal
+# helper jits; a solver entry point growing a new retrace per call site
+# blows well past these.  Recalibrate with
+# BLANCE_RECOMPILE_CALIBRATE=1 pytest tests/<module>.py.
+# Standalone calibration (jax 0.4.37 / CPU, 8 virtual devices):
+#   test_warm_replan  total=166 (impl 9, warm 6, carry 3; the '<unnamed'
+#                     bulk is eager-op + shard_map programs, inflated by
+#                     transfer-guard state flips busting the eager cache)
+#   test_sharded      total=190 (shard_map bodies log as '<unnamed')
+#   test_sharded_2d   total=171 (shared-process; standalone runs higher)
+_RECOMPILE_BUDGETS = {
+    "test_warm_replan": 220,
+    "test_sharded": 260,
+    "test_sharded_2d": 260,
+}
+
+
+class _CompileCounter(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__()
+        self.by_name: dict = {}
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if not msg.startswith("Compiling "):
+            return
+        name = msg.split(" ", 2)[1]
+        self.by_name[name] = self.by_name.get(name, 0) + 1
+
+    @property
+    def total(self) -> int:
+        return sum(self.by_name.values())
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _recompile_budget(request):
+    """Module-scoped retrace budget for the solver suites: snapshots XLA
+    compile events across the module and fails teardown when the count
+    exceeds the pinned budget — so a change that breaks the jit-cache
+    contract (new dynamic shape, a traced value becoming static, a
+    static becoming traced) cannot land silently."""
+    module = request.node.nodeid.split("::", 1)[0] \
+        .rsplit("/", 1)[-1].removesuffix(".py")
+    budget = _RECOMPILE_BUDGETS.get(module)
+    calibrate = bool(os.environ.get("BLANCE_RECOMPILE_CALIBRATE"))
+    if budget is None and not calibrate:
+        yield
+        return
+    counter = _CompileCounter()
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    prev_log_compiles = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    logger.addHandler(counter)
+    try:
+        yield
+    finally:
+        logger.removeHandler(counter)
+        jax.config.update("jax_log_compiles", prev_log_compiles)
+    if calibrate:
+        print(f"\n[recompile-calibrate] {module}: total={counter.total} "
+              f"by_name={dict(sorted(counter.by_name.items()))}")
+        return
+    assert counter.total <= budget, (
+        f"{module} triggered {counter.total} XLA compilations, over its "
+        f"pinned budget of {budget}: a solver entry point is retracing "
+        f"more than the shape-bucketing/static-args contract allows "
+        f"(per function: {dict(sorted(counter.by_name.items()))}); if "
+        f"the extra compiles are intended, recalibrate with "
+        f"BLANCE_RECOMPILE_CALIBRATE=1 and raise the budget")
 
 
 def planner_backends():

@@ -1,0 +1,540 @@
+"""Tests for the static-analysis suite itself (blance_tpu/analysis).
+
+Three layers, mirroring docs/STATIC_ANALYSIS.md:
+
+- rule fixtures: a snippet that MUST trip each rule, and a clean twin
+  that must NOT (the false-positive guard — a lint nobody trusts is a
+  lint nobody runs);
+- baseline semantics: matching (symbol/line pinning), stale-entry
+  detection, and the parse errors that keep the allowlist honest;
+- end-to-end: the real package carries zero non-baselined findings, an
+  injected violation fails the CLI, and the eval_shape contract table
+  passes against the live solver.
+"""
+
+import textwrap
+
+import pytest
+
+from blance_tpu.analysis import Finding, run_all, run_lints
+from blance_tpu.analysis.asyncio_lint import lint_source
+from blance_tpu.analysis.baseline import (
+    Baseline,
+    BaselineEntry,
+    parse_toml_findings,
+)
+from blance_tpu.analysis.jit_purity import JitPurityPass
+
+
+def _jit_findings(tmp_path, source, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return JitPurityPass([str(f)], repo_root=str(tmp_path)).run()
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- jit purity: each rule trips, and its clean twin does not ---------------
+
+
+def test_jit001_host_nondeterminism_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            t = time.perf_counter()
+            return x + t
+    """)
+    assert _rules(fs) == ["JIT001"]
+    assert fs[0].symbol == "f"
+
+
+def test_jit001_numpy_random_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + np.random.rand()
+    """)
+    assert _rules(fs) == ["JIT001"]
+
+
+def test_jit001_reached_helper_trips(tmp_path):
+    # Impurity in a helper REACHED from a jit root is still a finding.
+    fs = _jit_findings(tmp_path, """
+        import random
+        import jax
+
+        def helper(x):
+            return x * random.random()
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """)
+    assert _rules(fs) == ["JIT001"]
+    assert fs[0].symbol == "helper"
+
+
+def test_jit001_unreached_host_code_is_clean(tmp_path):
+    # The same impurity OUTSIDE the traced call graph is fine.
+    fs = _jit_findings(tmp_path, """
+        import time
+        import jax
+
+        def host_wrapper(x):
+            t0 = time.perf_counter()
+            out = f(x)
+            return out, time.perf_counter() - t0
+
+        @jax.jit
+        def f(x):
+            return x + 1
+    """)
+    assert fs == []
+
+
+def test_jit002_traced_branch_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert _rules(fs) == ["JIT002"]
+
+
+def test_jit002_static_and_is_none_branches_are_clean(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode, y=None):
+            if mode == "fast":
+                x = x * 2
+            if y is not None:
+                x = x + y
+            if x.shape[0] > 4:
+                x = x[:4]
+            return x
+    """)
+    assert fs == []
+
+
+def test_jit003_coercion_trips_and_shape_is_clean(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+
+        @jax.jit
+        def g(x):
+            n = int(x.shape[0])
+            return x * n
+    """)
+    assert _rules(fs) == ["JIT003"]
+    assert all(f.symbol == "f" for f in fs)
+
+
+def test_jit004_captured_mutation_trips_local_is_clean(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        _CACHE = {}
+        _SEEN = []
+
+        @jax.jit
+        def f(x):
+            _SEEN.append(1)
+            return x
+
+        @jax.jit
+        def g(x):
+            local = []
+            local.append(1)
+            return x
+
+        @jax.jit
+        def h(x):
+            global _MODE
+            _MODE = "hot"
+            return x
+    """)
+    assert _rules(fs) == ["JIT004"]
+    assert sorted(f.symbol for f in fs) == ["f", "h"]
+
+
+def test_jit004_subscript_write_does_not_hide_capture(tmp_path):
+    # d[k] = v must NOT make ``d`` look locally bound.
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        _MEMO = {}
+
+        @jax.jit
+        def f(x):
+            _MEMO["k"] = 1
+            _MEMO.clear()
+            return x
+    """)
+    assert _rules(fs) == ["JIT004"]
+
+
+def test_jit005_bogus_static_argname_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode", "modes"))
+        def f(x, mode):
+            return x
+    """)
+    assert _rules(fs) == ["JIT005"]
+    assert "modes" in fs[0].message
+
+
+def test_jit_roots_via_call_and_partial_forms(tmp_path):
+    # name = jax.jit(f, ...) and partial(jax.jit, ...)(f) both root f.
+    fs = _jit_findings(tmp_path, """
+        from functools import partial
+        import time
+        import jax
+
+        def f(x):
+            return x + time.time()
+
+        def g(x):
+            return x * time.time()
+
+        f_jit = jax.jit(f)
+        g_jit = partial(jax.jit, static_argnames=())(g)
+    """)
+    assert _rules(fs) == ["JIT001"]
+    assert sorted(x.symbol for x in fs) == ["f", "g"]
+
+
+def test_jit001_reached_through_package_reexport(tmp_path):
+    """Impurity must stay visible through the `from .impl import helper`
+    + `from . import helper` package re-export idiom the codebase uses
+    for its public surfaces."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "impl.py").write_text(textwrap.dedent("""
+        import time
+
+        def helper(x):
+            return x + time.time()
+    """))
+    (pkg / "__init__.py").write_text("from .impl import helper\n")
+    (pkg / "use.py").write_text(textwrap.dedent("""
+        import jax
+        from . import helper
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """))
+    files = [str(pkg / n) for n in ("__init__.py", "impl.py", "use.py")]
+    fs = JitPurityPass(files, repo_root=str(tmp_path)).run()
+    assert _rules(fs) == ["JIT001"]
+    assert fs[0].symbol == "helper" and fs[0].path == "pkg/impl.py"
+
+
+def test_jit_root_via_shard_map_wrapper(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        from functools import partial
+        import time
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def body(x):
+            return x + time.time()
+
+        def build(mesh, spec):
+            fn = _shard_map(partial(body), mesh=mesh,
+                            in_specs=spec, out_specs=spec)
+            return fn
+    """)
+    assert _rules(fs) == ["JIT001"]
+    assert fs[0].symbol == "body"
+
+
+# -- asyncio lint -----------------------------------------------------------
+
+
+def _asy(source):
+    return lint_source(textwrap.dedent(source), "/r/mod.py", "/r")
+
+
+def test_asy101_fire_and_forget_trips_stored_is_clean():
+    fs = _asy("""
+        import asyncio
+
+        async def bad(coro):
+            asyncio.ensure_future(coro)
+
+        async def good(coro, tasks):
+            t = asyncio.ensure_future(coro)
+            tasks.append(t)
+            await t
+    """)
+    assert _rules(fs) == ["ASY101"]
+    assert fs[0].symbol == "bad"
+
+
+def test_asy102_blocking_call_trips_async_sleep_is_clean():
+    fs = _asy("""
+        import asyncio
+        import time
+
+        async def bad():
+            time.sleep(1.0)
+
+        async def good():
+            await asyncio.sleep(1.0)
+
+        def sync_ok():
+            time.sleep(0.1)
+    """)
+    assert _rules(fs) == ["ASY102"]
+    assert fs[0].symbol == "bad"
+
+
+def test_asy103_silent_swallow_trips():
+    fs = _asy("""
+        def bad():
+            try:
+                work()
+            except Exception:
+                return False
+            return True
+    """)
+    assert _rules(fs) == ["ASY103"]
+
+
+def test_asy103_using_or_raising_handler_is_clean():
+    fs = _asy("""
+        import logging
+
+        def uses_exc():
+            try:
+                work()
+            except Exception as e:
+                logging.warning("failed: %s", e)
+                return False
+            return True
+
+        def reraises():
+            try:
+                work()
+            except Exception:
+                cleanup()
+                raise
+
+        def narrow():
+            try:
+                work()
+            except ValueError:
+                return False
+    """)
+    assert fs == []
+
+
+def test_asy104_undeadlined_callback_await_trips():
+    fs = _asy("""
+        class O:
+            async def run(self, node):
+                result = self._assign_partitions(node)
+                return await result
+    """)
+    assert _rules(fs) == ["ASY104"]
+
+
+def test_asy104_wait_for_wrapped_is_clean():
+    fs = _asy("""
+        import asyncio
+
+        class O:
+            async def run(self, node):
+                result = self._assign_partitions(node)
+                return await asyncio.wait_for(result, 5.0)
+    """)
+    assert fs == []
+
+
+# -- baseline semantics -----------------------------------------------------
+
+
+def _finding(rule="ASY103", path="pkg/m.py", line=10, symbol="f"):
+    return Finding(rule=rule, path=path, line=line, symbol=symbol,
+                   message="msg")
+
+
+def test_baseline_matches_on_rule_path_symbol():
+    b = Baseline([BaselineEntry(rule="ASY103", path="pkg/m.py",
+                                symbol="f", reason="why")])
+    new, accepted = b.split([_finding(), _finding(symbol="g")])
+    assert [f.symbol for f in new] == ["g"]
+    assert [(f.symbol, r) for f, r in accepted] == [("f", "why")]
+    assert b.unused() == []
+
+
+def test_baseline_line_pin_and_stale_entries():
+    entries = [
+        BaselineEntry(rule="ASY103", path="pkg/m.py", line=10,
+                      reason="pinned"),
+        BaselineEntry(rule="JIT001", path="pkg/other.py",
+                      reason="stale"),
+    ]
+    b = Baseline(entries)
+    new, accepted = b.split([_finding(line=10), _finding(line=11)])
+    assert [f.line for f in new] == [11]
+    assert len(accepted) == 1
+    assert [e.reason for e in b.unused()] == ["stale"]
+
+
+def test_baseline_toml_roundtrip_and_errors():
+    entries = parse_toml_findings(textwrap.dedent("""
+        # comment
+        [[finding]]
+        rule = "ASY103"
+        path = "pkg/m.py"  # trailing comment
+        symbol = "f"
+        line = 12
+        reason = "a \\"quoted\\" reason"
+    """))
+    assert len(entries) == 1
+    e = entries[0]
+    assert (e.rule, e.path, e.symbol, e.line) == \
+        ("ASY103", "pkg/m.py", "f", 12)
+    assert e.reason == 'a "quoted" reason'
+
+    # Shared validation: identical on the tomllib and subset paths.
+    with pytest.raises(ValueError, match="missing required key"):
+        parse_toml_findings('[[finding]]\nrule = "X"\npath = "p"\n')
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_toml_findings(
+            '[[finding]]\nrule = "X"\npath = "p"\nreason = "r"\n'
+            'bogus = "v"\n')
+    # A stray top-level key is an error on either path (the messages
+    # differ: tomllib flags the unknown table, the subset the bare key).
+    with pytest.raises(ValueError):
+        parse_toml_findings('rule = "X"\n')
+
+
+def test_baseline_subset_parser_errors():
+    """The 3.10 fallback parser's own strictness (exercised explicitly
+    so the tomllib path on newer Pythons doesn't mask it)."""
+    from blance_tpu.analysis.baseline import _parse_subset
+
+    with pytest.raises(ValueError, match="unsupported"):
+        _parse_subset('[[finding]]\nrule = [1]\n', "<t>")
+    with pytest.raises(ValueError, match="outside"):
+        _parse_subset('rule = "X"\n', "<t>")
+    with pytest.raises(ValueError, match="expected key"):
+        _parse_subset('[[finding]]\njunk\n', "<t>")
+    entries = _parse_subset(
+        '[[finding]]\nrule = "R"\npath = "p"\nreason = "r"\nline = 3\n',
+        "<t>")
+    assert entries[0].line == 3
+
+
+# -- end-to-end -------------------------------------------------------------
+
+
+def test_package_has_zero_nonbaselined_findings():
+    """The gate the static CI tier enforces, minus the shape audit
+    (covered separately below so this stays sub-second)."""
+    result = run_all(shape_audit=False)
+    assert result.errors == []
+    rendered = "\n".join(f.render() for f in result.new)
+    assert result.new == [], f"non-baselined findings:\n{rendered}"
+    # The allowlist carries no dead weight.
+    stale = [e.render() for e in result.unused_baseline]
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_lints_cover_expected_file_count():
+    _, nfiles = run_lints()
+    # The package's module count only grows; a collapse here means the
+    # walker lost a directory.
+    assert nfiles >= 30
+
+
+def test_cli_fails_on_injected_violation(tmp_path, capsys):
+    from blance_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "violation.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + time.time()
+    """))
+    rc = main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JIT001" in out and "FAIL" in out
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x\n")
+    assert main([str(clean)]) == 0
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    from blance_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "violation.py"
+    bad.write_text(
+        "import asyncio\n\nasync def f(c):\n"
+        "    asyncio.ensure_future(c)\n")
+    rc = main(["--json", str(bad)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["pass"] is False
+    assert [f["rule"] for f in payload["new"]] == ["ASY101"]
+
+
+def test_shape_audit_passes_against_live_solver():
+    """Every declared contract holds on the real entry points; the full
+    matrix (cold/carry/bucketed/sharded + encode/decode + bucketing
+    algebra) runs in seconds with zero FLOPs."""
+    from blance_tpu.analysis.shape_audit import CONTRACTS, run_shape_audit
+
+    findings, entries = run_shape_audit()
+    rendered = "\n".join(f.render() for f in findings)
+    assert findings == [], f"shape contract violations:\n{rendered}"
+    assert entries == len(CONTRACTS) + 2
+    # Acceptance coverage: warm, sharded and bucketed variants all audit.
+    entry_names = {c.entry for c in CONTRACTS}
+    assert {"solve_dense", "solve_dense_converged", "solve_dense_warm",
+            "solve_dense_sharded", "carry_from_assignment"} <= entry_names
+    assert any("bucketed" in c.variant for c in CONTRACTS)
+
+
+def test_shape_audit_catches_drift(monkeypatch):
+    """Break a contract deliberately: the audit must report SHP001."""
+    from blance_tpu.analysis import shape_audit as sa
+
+    broken = sa.ShapeContract(
+        entry="solve_dense", variant="drifted",
+        build=lambda: sa._build_solve_dense(sa.Dims(P=8, S=1, N=5, R=1)),
+        expect=lambda: ((8, 1, 2), "int32"))  # wrong R
+    monkeypatch.setattr(sa, "CONTRACTS", (broken,))
+    findings, _ = sa.run_shape_audit()
+    assert any(f.rule == "SHP001" for f in findings)
