@@ -17,6 +17,15 @@ blance_tpu's own static layer, run as the ``static`` CI tier:
 - :mod:`.asyncio_lint` — AST lint over the asyncio control plane:
   fire-and-forget tasks, blocking calls in ``async def``, silent broad
   exception swallows, un-deadlined app-callback awaits.
+- :mod:`.race_lint` — await-atomicity race lint over the control
+  plane's declared shared state: read-modify-writes spanning an
+  ``await``, stale guard flags, multi-task mutation without a
+  serialization point (RACE0xx).
+- :mod:`.schedule` — the dynamic companion: deterministic schedule
+  exploration (``python -m blance_tpu.analysis.schedule``) replaying
+  orchestrator scenarios under seeded and bounded-exhaustive
+  interleavings against declared invariants, built on
+  :mod:`blance_tpu.testing.sched`.
 - :mod:`.shape_audit` — a declarative shape-contract table for the
   solver's public entry points, checked with ``jax.eval_shape`` across a
   (P, S, N, R) x bucketing x carry matrix: zero FLOPs, seconds of
@@ -31,7 +40,7 @@ CLI: ``python -m blance_tpu.analysis [--ci]`` (see __main__.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Iterable, Optional
+from typing import Any, Iterable, Optional
 
 __all__ = [
     "Finding",
@@ -76,15 +85,17 @@ class AnalysisResult:
 
     new: list[Finding]  # non-baselined findings (these fail the build)
     baselined: list[tuple[Finding, str]]  # (finding, reason) pairs
-    unused_baseline: list  # BaselineEntry objects that matched nothing
+    # BaselineEntry objects that matched nothing (typed loosely: the
+    # baseline module is imported lazily to keep the editor loop light)
+    unused_baseline: list[Any]
     checked_files: int = 0
     shape_entries: int = 0
     # analyzer crashes (fatal)
     errors: list[str] = field(default_factory=list)
 
 
-def _iter_py_files(paths: Iterable[str]) -> list:
-    out = []
+def _iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
     for p in paths:
         if os.path.isfile(p) and p.endswith(".py"):
             out.append(p)
@@ -101,7 +112,8 @@ def _iter_py_files(paths: Iterable[str]) -> list:
     return out
 
 
-def run_lints(paths: Optional[list] = None) -> tuple:
+def run_lints(
+        paths: Optional[list[str]] = None) -> tuple[list[Finding], int]:
     """Run the two AST passes over ``paths`` (default: the package).
 
     Returns (findings, checked_file_count).  Pure host work — safe to
@@ -109,21 +121,25 @@ def run_lints(paths: Optional[list] = None) -> tuple:
     """
     from .asyncio_lint import lint_file as asyncio_lint_file
     from .jit_purity import JitPurityPass
+    from .race_lint import lint_file as race_lint_file
 
     files = _iter_py_files(paths or [PACKAGE_ROOT])
-    findings: list = []
+    findings: list[Finding] = []
     # jit purity needs the whole module set up front (cross-module call
-    # resolution); asyncio lint is per-file.
+    # resolution); the asyncio and race lints are per-file (the race
+    # lint's shared-state model keys on class names, so it is inert
+    # outside the control plane by construction).
     jit_pass = JitPurityPass(files, repo_root=REPO_ROOT)
     findings.extend(jit_pass.run())
     for f in files:
         findings.extend(asyncio_lint_file(f, repo_root=REPO_ROOT))
+        findings.extend(race_lint_file(f, repo_root=REPO_ROOT))
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings, len(files)
 
 
 def run_all(
-    paths: Optional[list] = None,
+    paths: Optional[list[str]] = None,
     baseline_path: Optional[str] = None,
     shape_audit: bool = True,
 ) -> AnalysisResult:
@@ -133,7 +149,7 @@ def run_all(
 
     findings, nfiles = run_lints(paths)
     shape_entries = 0
-    errors: list = []
+    errors: list[str] = []
     if shape_audit:
         from .shape_audit import run_shape_audit
 

@@ -3,9 +3,11 @@
 Exit status is the contract CI consumes: 0 when every finding is either
 fixed or pinned in analysis/baseline.toml, nonzero when any NEW finding
 exists (or an analyzer itself crashed).  ``--ci`` is the full gate (AST
-lints + eval_shape audit); the default run skips the shape audit so the
-editor loop stays sub-second and jax-import-free (``--shape-audit``
-forces it back on).
+lints + eval_shape audit) and additionally promotes stale baseline
+entries to hard errors, so a fix that removes a finding must delete its
+suppression in the same change; the default run skips the shape audit
+so the editor loop stays sub-second and jax-import-free
+(``--shape-audit`` forces it back on).
 """
 
 from __future__ import annotations
@@ -59,7 +61,12 @@ def main(argv=None) -> int:
         shape_audit=shape,
     )
 
-    failed = bool(result.new) or bool(result.errors)
+    # Stale pins are warnings in the editor loop but HARD ERRORS under
+    # --ci: a fixed finding must delete its suppression in the same
+    # change, or dead entries accumulate and mask the next real finding
+    # that happens to match them.
+    stale_fails = args.ci and bool(result.unused_baseline)
+    failed = bool(result.new) or bool(result.errors) or stale_fails
     if args.json:
         print(json.dumps({
             "new": [f.__dict__ for f in result.new],
@@ -79,8 +86,10 @@ def main(argv=None) -> int:
         for e in result.errors:
             print(f"ERROR: {e}")
         for e in result.unused_baseline:
-            print(f"warning: stale baseline entry (matched nothing): "
-                  f"{e.render()}")
+            prefix = "ERROR" if args.ci else "warning"
+            print(f"{prefix}: stale baseline entry (matched nothing): "
+                  f"{e.render()}"
+                  + (" — delete it" if args.ci else ""))
         n_base = len(result.baselined)
         print(f"blance_tpu.analysis: {result.checked_files} files, "
               f"{result.shape_entries} shape contracts, "

@@ -1,0 +1,44 @@
+"""AST helpers shared by the analysis passes.
+
+One copy of the dotted-path resolver and the per-file Finding emitter:
+jit_purity, asyncio_lint and race_lint all resolve attribute chains and
+anchor findings to repo-relative paths, and three diverging copies is
+how a path-normalization fix silently misses a pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from . import Finding
+
+__all__ = ["dotted", "FindingEmitter"]
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Attribute/Name chain -> "a.b.c", else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FindingEmitter:
+    """Collects findings for one file, anchored to its repo-relative
+    forward-slash path."""
+
+    def __init__(self, path: str, repo_root: str) -> None:
+        self.rel = os.path.relpath(
+            os.path.abspath(path), repo_root).replace(os.sep, "/")
+        self.findings: list[Finding] = []
+
+    def emit(self, rule: str, line: int, symbol: str, message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.rel, line=line, symbol=symbol,
+            message=message))

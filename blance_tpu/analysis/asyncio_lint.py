@@ -34,10 +34,10 @@ ASY101/102/104 only apply under ``async def``; ASY103 is package-wide.
 from __future__ import annotations
 
 import ast
-import os
 from typing import Optional
 
 from . import Finding
+from ._astutil import FindingEmitter as _FileLint, dotted as _dotted
 
 __all__ = ["lint_file", "lint_source"]
 
@@ -63,17 +63,6 @@ _BLOCKING = {
 _CALLBACK_ATTRS = {"_assign_partitions", "assign_partitions"}
 
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    parts: list = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
 def _is_spawn_call(node: ast.AST) -> bool:
     if not isinstance(node, ast.Call):
         return False
@@ -94,7 +83,7 @@ class _AsyncRules(ast.NodeVisitor):
         self.qualname = qualname
         # Names holding values produced by a callback attribute call:
         # result = self._assign_partitions(...)
-        self.callback_values: set = set()
+        self.callback_values: set[str] = set()
 
     def run(self) -> None:
         for stmt in self.func.body:
@@ -220,20 +209,8 @@ def _broad_except_type(handler: ast.ExceptHandler) -> Optional[str]:
     return None
 
 
-class _FileLint:
-    def __init__(self, path: str, repo_root: str) -> None:
-        self.rel = os.path.relpath(
-            os.path.abspath(path), repo_root).replace(os.sep, "/")
-        self.findings: list = []
-
-    def emit(self, rule: str, line: int, symbol: str,
-             message: str) -> None:
-        self.findings.append(Finding(
-            rule=rule, path=self.rel, line=line, symbol=symbol,
-            message=message))
-
-
-def lint_source(src: str, path: str, repo_root: str) -> list:
+def lint_source(src: str, path: str,
+                repo_root: str) -> list[Finding]:
     lint = _FileLint(path, repo_root)
     try:
         tree = ast.parse(src, filename=path)
@@ -288,6 +265,6 @@ def lint_source(src: str, path: str, repo_root: str) -> list:
     return lint.findings
 
 
-def lint_file(path: str, repo_root: str) -> list:
+def lint_file(path: str, repo_root: str) -> list[Finding]:
     with open(path) as f:
         return lint_source(f.read(), path, repo_root)

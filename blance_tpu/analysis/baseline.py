@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 __all__ = ["Baseline", "BaselineEntry", "parse_toml_findings"]
 
@@ -76,7 +76,9 @@ def _parse_value(raw: str, path: str, lineno: int):
         f"subset accepts double-quoted strings and integers only)")
 
 
-def parse_toml_findings(text: str, path: str = "<baseline>") -> list:
+def parse_toml_findings(text: str,
+                        path: str = "<baseline>"
+                        ) -> list["BaselineEntry"]:
     """Parse the ``[[finding]]`` array tables out of a TOML document:
     stdlib ``tomllib`` where available, the subset reader on 3.10."""
     try:
@@ -99,10 +101,10 @@ def parse_toml_findings(text: str, path: str = "<baseline>") -> list:
     return _entries_from_dicts(findings, path)
 
 
-def _parse_subset(text: str, path: str) -> list:
+def _parse_subset(text: str, path: str) -> list["BaselineEntry"]:
     """The dependency-free 3.10 fallback parser."""
-    entries: list = []
-    current: Optional[dict] = None
+    entries: list[dict[str, object]] = []
+    current: Optional[dict[str, object]] = None
     for lineno, raw_line in enumerate(text.splitlines(), start=1):
         line = raw_line.strip()
         if not line or line.startswith("#"):
@@ -139,7 +141,8 @@ def _parse_subset(text: str, path: str) -> list:
     return _entries_from_dicts(entries, path)
 
 
-def _entries_from_dicts(entries: list, path: str) -> list:
+def _entries_from_dicts(entries: list[dict[str, object]],
+                        path: str) -> list["BaselineEntry"]:
     """Shared strict validation — both parse paths come through here."""
     out = []
     for i, e in enumerate(entries):
@@ -164,7 +167,7 @@ def _entries_from_dicts(entries: list, path: str) -> list:
 class Baseline:
     """The loaded allowlist; splits findings into new vs accepted."""
 
-    def __init__(self, entries: list) -> None:
+    def __init__(self, entries: list["BaselineEntry"]) -> None:
         self.entries = entries
 
     @classmethod
@@ -174,7 +177,9 @@ class Baseline:
         with open(path) as f:
             return cls(parse_toml_findings(f.read(), path))
 
-    def split(self, findings: list) -> tuple:
+    def split(
+        self, findings: list[Any],
+    ) -> tuple[list[Any], list[tuple[Any, str]]]:
         """-> (new_findings, [(finding, reason), ...])."""
         new, accepted = [], []
         for f in findings:
@@ -186,8 +191,11 @@ class Baseline:
                 accepted.append((f, entry.reason))
         return new, accepted
 
-    def unused(self) -> list:
-        """Entries that matched nothing — stale pins worth deleting
-        (surfaced as warnings, not failures: a fix that removes a finding
-        must not break the build it improved)."""
+    def unused(self) -> list["BaselineEntry"]:
+        """Entries that matched nothing — stale pins that must be
+        deleted in the same change that fixed their finding.  The CLI
+        surfaces them as warnings in the editor loop and as HARD ERRORS
+        under ``--ci`` (__main__.py): dead suppressions otherwise
+        accumulate and mask the next real finding that happens to match
+        them."""
         return [e for e in self.entries if not e.used]

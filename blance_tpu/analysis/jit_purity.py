@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from . import Finding
+from ._astutil import dotted as _dotted
 
 __all__ = ["JitPurityPass"]
 
@@ -83,14 +84,14 @@ class FuncInfo:
     qualname: str  # "fn" or "Class.method"
     node: ast.AST  # FunctionDef | AsyncFunctionDef
     path: str  # repo-relative file path
-    params: list = field(default_factory=list)
+    params: list[str] = field(default_factory=list)
     # Params with literal defaults: when such a function becomes a trace
     # root through shard_map/partial wrapping (no static_argnames to
     # consult), branching on them is almost always the benign
     # Python-default pattern — exempt from JIT002/JIT003.
-    defaulted: set = field(default_factory=set)
+    defaulted: set[str] = field(default_factory=set)
     is_root: bool = False
-    statics: set = field(default_factory=set)  # declared static argnames
+    statics: set[str] = field(default_factory=set)  # declared static argnames
 
     @property
     def fq(self) -> str:
@@ -104,9 +105,9 @@ class ModuleInfo:
     tree: ast.Module
     is_pkg: bool = False  # an __init__.py (relative imports resolve
     # against the package itself, not its parent)
-    imports: dict = field(default_factory=dict)  # local name -> fq prefix
-    functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
-    constants: dict = field(default_factory=dict)  # name -> literal value
+    imports: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, "FuncInfo"] = field(default_factory=dict)
+    constants: dict[str, object] = field(default_factory=dict)
 
 
 def _module_name(path: str, repo_root: str) -> str:
@@ -118,19 +119,8 @@ def _module_name(path: str, repo_root: str) -> str:
     return ".".join(parts)
 
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    """Attribute/Name chain -> "a.b.c", else None."""
-    parts: list = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _literal_strings(node: ast.AST, constants: dict) -> Optional[list]:
+def _literal_strings(node: ast.AST, constants: dict[str, object]
+                     ) -> Optional[list[str]]:
     """Extract a tuple/list of string literals, following one level of
     module-constant indirection (the ``_WARM_STATICS`` idiom)."""
     if isinstance(node, ast.Name) and node.id in constants:
@@ -155,10 +145,10 @@ def _literal_strings(node: ast.AST, constants: dict) -> Optional[list]:
 class JitPurityPass:
     """Whole-program pass: build the index, find roots, walk, lint."""
 
-    def __init__(self, files: list, repo_root: str) -> None:
+    def __init__(self, files: list[str], repo_root: str) -> None:
         self.repo_root = repo_root
-        self.modules: dict = {}
-        self.findings: list = []
+        self.modules: dict[str, ModuleInfo] = {}
+        self.findings: list[Finding] = []
         for path in files:
             try:
                 with open(path) as f:
@@ -211,7 +201,7 @@ class JitPurityPass:
                 params.append(args.vararg.arg)
             if args.kwarg:
                 params.append(args.kwarg.arg)
-            defaulted: set = set()
+            defaulted: set[str] = set()
             pos = [a.arg for a in args.posonlyargs] + \
                 [a.arg for a in args.args]
             for name_, default in zip(pos[len(pos) - len(args.defaults):],
@@ -317,7 +307,7 @@ class JitPurityPass:
         return any(leaf == s for s in _TRACE_WRAPPER_SUFFIXES)
 
     def _mark_root(self, mi: ModuleInfo, func_ref: ast.AST,
-                   statics: set, aliases: dict) -> None:
+                   statics: set[str], aliases: dict[str, str]) -> None:
         """func_ref names (possibly via a partial alias) a function."""
         target = None
         if isinstance(func_ref, ast.Call):
@@ -350,11 +340,11 @@ class JitPurityPass:
         return self._lookup_function(mi, inner)
 
     def _jit_statics(self, mi: ModuleInfo, call: ast.Call,
-                     wrapped) -> set:
+                     wrapped) -> set[str]:
         """Parse static_argnames/donate_argnames off a jit(...) call,
         emitting JIT005 findings against the wrapped function.  Only
         static argnames are returned (donated args are still traced)."""
-        statics: set = set()
+        statics: set[str] = set()
         for kw in call.keywords:
             if kw.arg not in ("static_argnames", "donate_argnames"):
                 continue
@@ -447,10 +437,11 @@ class JitPurityPass:
                 fn.is_root = True
                 fn.statics |= self._jit_statics(mi, dec, fn)
 
-    def _collect_aliases(self, mi: ModuleInfo, tree: ast.AST) -> dict:
+    def _collect_aliases(self, mi: ModuleInfo,
+                         tree: ast.AST) -> dict[str, str]:
         """name -> dotted function reference, for ``x = partial(f, ...)``
         and ``x = f`` bindings."""
-        aliases: dict = {}
+        aliases: dict[str, str] = {}
         for node in ast.walk(tree):
             if not isinstance(node, ast.Assign) or len(node.targets) != 1:
                 continue
@@ -473,7 +464,7 @@ class JitPurityPass:
 
     # -- reachability -------------------------------------------------------
 
-    def _reachable(self) -> list:
+    def _reachable(self) -> list["FuncInfo"]:
         roots = [fn for mi in self.modules.values()
                  for fn in mi.functions.values() if fn.is_root]
         seen = {fn.fq for fn in roots}
@@ -511,7 +502,7 @@ class JitPurityPass:
 
     # -- the lint -----------------------------------------------------------
 
-    def run(self) -> list:
+    def run(self) -> list[Finding]:
         self._find_roots()
         for fn in self._reachable():
             self._lint_function(fn)
@@ -523,7 +514,7 @@ class JitPurityPass:
             rule=rule, path=fn.path, line=line, symbol=fn.qualname,
             message=message))
 
-    def _local_names(self, fn: FuncInfo) -> set:
+    def _local_names(self, fn: FuncInfo) -> set[str]:
         names = set(fn.params)
         for node in ast.walk(fn.node):
             if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
@@ -632,11 +623,12 @@ class JitPurityPass:
                         f"raises TracerBoolConversionError; use lax.cond/"
                         f"jnp.where, or declare it static")
 
-    def _traced_branch_names(self, test: ast.AST, traced: set) -> set:
+    def _traced_branch_names(self, test: ast.AST,
+                             traced: set[str]) -> set[str]:
         """Direct traced-parameter references in a branch test, minus
         ``x is None`` / ``x is not None`` presence checks and attribute
         accesses (``x.shape`` etc. are static under tracing)."""
-        exempt: set = set()
+        exempt: set[str] = set()
         for node in ast.walk(test):
             if isinstance(node, ast.Compare) and \
                     all(isinstance(op, (ast.Is, ast.IsNot))

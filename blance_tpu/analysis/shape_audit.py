@@ -53,13 +53,13 @@ class Dims(NamedTuple):
     L: int = 1  # hierarchy levels (gids rows)
 
     @property
-    def constraints(self) -> tuple:
+    def constraints(self) -> tuple[int, ...]:
         # Full-depth slots for every state; max(constraints) == R by
         # construction, the solver's own validity precondition.
         return (self.R,) * self.S
 
     @property
-    def rules(self) -> tuple:
+    def rules(self) -> tuple[tuple[tuple[int, int], ...], ...]:
         # One (include, exclude) rule on the last state when there is
         # more than one hierarchy level, else rule-free.
         if self.L < 2 or self.S < 2:
@@ -79,8 +79,8 @@ class ShapeContract:
 
     entry: str  # reported entry-point name
     variant: str  # "cold" / "carry" / "bucketed" / ...
-    build: Callable
-    expect: Callable
+    build: Callable[..., object]
+    expect: Callable[..., object]
 
 
 def _sds(shape, dtype):
@@ -236,7 +236,7 @@ _MATRIX = (
     Dims(P=24, S=3, N=9, R=3, L=2),
 )
 
-CONTRACTS: tuple = tuple(
+CONTRACTS: tuple[ShapeContract, ...] = tuple(
     [
         ShapeContract(
             entry="solve_dense", variant=f"cold@{d.P}x{d.N}",
@@ -316,12 +316,12 @@ def _flatten_expect(exp):
     return out
 
 
-def _check_one(contract: ShapeContract) -> list:
+def _check_one(contract: ShapeContract) -> list[Finding]:
     import numpy as np
 
     import jax
 
-    findings: list = []
+    findings: list[Finding] = []
     label = f"{contract.entry}[{contract.variant}]"
     try:
         fn, args, kwargs = contract.build()
@@ -362,7 +362,7 @@ def _check_one(contract: ShapeContract) -> list:
     return findings
 
 
-def _check_encode_decode() -> list:
+def _check_encode_decode() -> list[Finding]:
     """Concrete (tiny) encode/decode round trip: dense dtypes + map
     shape.  Host-only, milliseconds."""
     import numpy as np
@@ -370,7 +370,7 @@ def _check_encode_decode() -> list:
     from ..core.encode import decode_assignment, encode_problem
     from ..core.types import Partition, PartitionModelState, PlanOptions
 
-    findings: list = []
+    findings: list[Finding] = []
     label = "encode_problem/decode_assignment"
     try:
         model = {
@@ -422,14 +422,14 @@ def _check_encode_decode() -> list:
     return findings
 
 
-def _check_bucketing_algebra() -> list:
+def _check_bucketing_algebra() -> list[Finding]:
     """bucket_size/pad_to host contracts: result >= x, monotone,
     overhead bounded by 1/granularity, idempotent."""
     import numpy as np
 
     from ..core.encode import _BUCKET_GRANULARITY, bucket_size, pad_to
 
-    findings: list = []
+    findings: list[Finding] = []
     label = "bucket_size/pad_to"
     prev = 0
     for x in list(range(1, 200)) + [255, 256, 257, 1000, 1007, 4096,
@@ -474,9 +474,9 @@ def _check_bucketing_algebra() -> list:
     return findings
 
 
-def run_shape_audit() -> tuple:
+def run_shape_audit() -> tuple[list[Finding], int]:
     """Run the whole table.  Returns (findings, entries_checked)."""
-    findings: list = []
+    findings: list[Finding] = []
     for contract in CONTRACTS:
         findings.extend(_check_one(contract))
     findings.extend(_check_encode_decode())

@@ -50,7 +50,8 @@ class _Waiter:
 
     __slots__ = ("future", "token", "index")
 
-    def __init__(self, future: asyncio.Future, token: _Token, index: int) -> None:
+    def __init__(self, future: "asyncio.Future[object]", token: _Token,
+                 index: int) -> None:
         self.future = future
         self.token = token
         self.index = index
@@ -130,7 +131,8 @@ class Chan:
         if got is not None:
             return got
         token = _Token()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: "asyncio.Future[object]" = \
+            asyncio.get_running_loop().create_future()
         self._add_getter(_Waiter(fut, token, 0))
         _, value = await fut
         return value
@@ -139,7 +141,8 @@ class Chan:
         if self._try_put(item):
             return
         token = _Token()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut: "asyncio.Future[object]" = \
+            asyncio.get_running_loop().create_future()
         self._add_putter(_Waiter(fut, token, 0), item)
         _, err = await fut
         if err is not None:
@@ -178,7 +181,7 @@ GET = "get"
 PUT = "put"
 
 
-async def select(*ops: tuple) -> tuple[int, Any]:
+async def select(*ops: tuple[Any, ...]) -> tuple[int, Any]:
     """Wait for the first ready op among (GET, chan) / (PUT, chan, item).
 
     Returns (index, value) where value is (item, ok) for a get and None for
@@ -196,7 +199,8 @@ async def select(*ops: tuple) -> tuple[int, Any]:
 
     # Second pass: register on all, await first commit.
     token = _Token()
-    fut: asyncio.Future = asyncio.get_running_loop().create_future()
+    fut: "asyncio.Future[object]" = \
+        asyncio.get_running_loop().create_future()
     chans = []
     for i, op in enumerate(ops):
         waiter = _Waiter(fut, token, i)

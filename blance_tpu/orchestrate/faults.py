@@ -44,7 +44,8 @@ __all__ = ["FaultInjected", "NodeFaults", "FaultPlan"]
 class FaultInjected(Exception):
     """The scripted failure a FaultPlan raises in place of the callback."""
 
-    def __init__(self, node: str, partitions: tuple, attempt: int) -> None:
+    def __init__(self, node: str, partitions: tuple[str, ...],
+                 attempt: int) -> None:
         super().__init__(
             f"injected fault: node={node} partitions={list(partitions)} "
             f"attempt={attempt}")
@@ -86,12 +87,13 @@ class FaultPlan:
     """Seeded, scripted chaos for an assign_partitions callback."""
 
     seed: int = 0
-    nodes: dict = field(default_factory=dict)  # node -> NodeFaults
+    nodes: dict[str, NodeFaults] = field(default_factory=dict)
     # bookkeeping (all deterministic given the schedule):
-    attempts: dict = field(default_factory=dict)  # (node, partition) -> n
-    node_attempts: dict = field(default_factory=dict)  # node -> n
-    injected: dict = field(default_factory=dict)  # kind -> count
-    events: list = field(default_factory=list)  # (node, partitions, decision)
+    attempts: dict[tuple[str, str], int] = field(default_factory=dict)
+    node_attempts: dict[str, int] = field(default_factory=dict)
+    injected: dict[str, int] = field(default_factory=dict)
+    events: list[tuple[str, tuple[str, ...], str]] = \
+        field(default_factory=list)
 
     def decide(self, node: str, partition: str, attempt: int) -> str:
         """Scripted outcome for one (node, partition, attempt): "ok",

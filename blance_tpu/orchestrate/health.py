@@ -65,7 +65,7 @@ class HealthTracker:
     threshold: int = 3
     probe_after_s: float = 1.0
     clock: Callable[[], float] = time.monotonic
-    _nodes: dict = field(default_factory=dict)
+    _nodes: dict[str, NodeHealth] = field(default_factory=dict)
 
     def _get(self, node: str) -> NodeHealth:
         h = self._nodes.get(node)

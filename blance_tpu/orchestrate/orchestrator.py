@@ -320,7 +320,13 @@ class Orchestrator:
         self._progress = OrchestratorProgress()
         self._map_partition_to_next_moves = map_partition_to_next_moves
 
-        self._tasks: list[asyncio.Task] = []
+        self._tasks: list["asyncio.Task[object]"] = []
+        # Monotone spawn counter: gives every orchestration task a
+        # stable, human-readable name (mover/supplier/feeder + ordinal).
+        # The schedule explorer (testing/sched.py) keys its step labels
+        # — and therefore schedule signatures — off task names, so this
+        # is the hook that makes explorer traces legible.
+        self._spawn_seq = 0
         # Every progress counter is mirrored into the obs Recorder
         # (orchestrate.tot_*) as it increments, so one sink sees the
         # progress stream, the planner spans, and the move lifecycle
@@ -427,10 +433,15 @@ class Orchestrator:
         UserWarning plus an ``orchestrate.task_exceptions`` counter
         instead of vanishing."""
         task = asyncio.ensure_future(coro)
+        if isinstance(task, asyncio.Task):
+            self._spawn_seq += 1
+            task.set_name(
+                f"{getattr(coro, '__qualname__', 'orchestrate-task')}"
+                f"-{self._spawn_seq}")
         self._tasks = [t for t in self._tasks if not t.done()]
         self._tasks.append(task)
 
-        def _observe(t: "asyncio.Task") -> None:
+        def _observe(t: "asyncio.Task[object]") -> None:
             if t.cancelled():
                 return
             exc = t.exception()  # marks the exception retrieved
@@ -728,6 +739,41 @@ class Orchestrator:
                 available.setdefault(nm.moves[nm.next].node, []).append(nm)
         return available
 
+    async def _wait_while_paused(self) -> None:
+        """Block the supplier between rounds while paused, REVALIDATING
+        ``self._pause_ch`` after every wake.
+
+        The pre-fix spelling captured the channel once and waited on the
+        capture: a pause→resume→pause cycle landing inside the
+        pause-counter put (a blocking progress rendezvous) closed the
+        captured channel and parked the NEW one — the wait returned
+        immediately and the supplier fed a fresh round while the
+        orchestrator was logically paused (RACE002, the stale-guard
+        window analysis/race_lint.py flags; the committed schedule
+        trace in tests/test_race_regressions.py replays the exact
+        interleaving).  Re-reading the attribute after each wake closes
+        the window.
+
+        EVERY progress bump in here is itself a blocking rendezvous a
+        consumer can act inside — including the resume bump — so the
+        decisive ``_pause_ch is None`` check is the one made after the
+        resume bump, with no suspension point between it and the
+        return: a pause landing during any earlier await sends the
+        supplier back around the outer loop (surfacing each cycle as a
+        pause+resume counter pair — honest accounting, and the event
+        traffic keeps a snapshot-driven consumer live while the
+        supplier stays correctly parked)."""
+        while True:
+            await self._bump("tot_run_supply_moves_pause")
+            while True:
+                pause_ch = self._pause_ch
+                if pause_ch is None:
+                    break
+                await pause_ch.get()
+            await self._bump("tot_run_supply_moves_resume")
+            if self._pause_ch is None:
+                return
+
     async def _run_supply_moves(self, stop_ch: Chan, run_mover_done_ch: Chan) -> None:
         """The round loop (orchestrate.go:509-618)."""
         err_outer = None
@@ -744,9 +790,7 @@ class Orchestrator:
             # Pause blocks the whole supplier between rounds; Stop() while
             # paused requires a resume first (orchestrate.go:531-544).
             if pause_ch is not None:
-                await self._bump("tot_run_supply_moves_pause")
-                await pause_ch.get()
-                await self._bump("tot_run_supply_moves_resume")
+                await self._wait_while_paused()
 
             broadcast_stop_ch = Chan()
             broadcast_done_ch = Chan()

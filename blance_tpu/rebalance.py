@@ -152,7 +152,7 @@ def _session_matches(session: "PlannerSession", cur: PartitionMap) -> bool:
     return current == cur
 
 
-def _strip_nodes(pmap: PartitionMap, nodes: set) -> PartitionMap:
+def _strip_nodes(pmap: PartitionMap, nodes: set[str]) -> PartitionMap:
     """Drop every placement on ``nodes`` — the recovery presumption that
     a quarantined node's data is lost, so no 'del' move is owed to it."""
     if not nodes:

@@ -1,1 +1,8 @@
-"""blance_tpu.testing subpackage."""
+"""blance_tpu.testing subpackage.
+
+- :mod:`.vis` — plan/transition visualization helpers.
+- :mod:`.sched` — deterministic asyncio schedule exploration (the
+  controlled loop, seeded walks, bounded-exhaustive enumeration, and
+  replayable schedule traces) used by the race-detection tier
+  (``blance_tpu.analysis.schedule``) and the regression tests.
+"""

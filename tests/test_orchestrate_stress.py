@@ -72,6 +72,20 @@ def test_random_pause_resume_stop_orderings(seed, interrupt):
         actions = 0
         last = None
         pauses = resumes = 0
+        stopped = False
+        resumers: list[asyncio.Task] = []
+
+        async def resume_soon():
+            # An out-of-band controller: a pause with no eventual
+            # resume wedges BY CONTRACT (the supplier revalidates
+            # _pause_ch after every wake instead of escaping through
+            # the stale-channel race it used to have), and a consumer
+            # that only acts on progress events can starve itself —
+            # exactly like a real app, resumes must not depend on
+            # progress traffic while paused.
+            await asyncio.sleep(0)
+            o.resume_new_assignments()
+
         async for progress in o.progress_ch():
             # Counter monotonicity.
             if last is not None:
@@ -82,15 +96,19 @@ def test_random_pause_resume_stop_orderings(seed, interrupt):
             last = progress
             actions += 1
             r = rng.random()
-            if r < 0.2:
+            if r < 0.2 and not stopped:
                 o.pause_new_assignments()
                 pauses += 1
+                resumers.append(asyncio.ensure_future(resume_soon()))
             elif r < 0.5:
                 o.resume_new_assignments()
                 resumes += 1
             if actions == stop_after:
+                stopped = True
                 o.resume_new_assignments()  # stop while paused would wedge
                 o.stop()
+        for t in resumers:
+            await t
         # Stream closed; orchestrator must be fully wound down.
         assert last is not None
         assert last.tot_pause_new_assignments >= last.tot_resume_new_assignments

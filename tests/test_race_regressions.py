@@ -1,0 +1,243 @@
+"""Race regressions: schedule-explorer scenarios + committed traces.
+
+Two layers:
+
+- The committed trace ``tests/traces/pause_cycle_guard.json`` replays
+  the exact interleaving where a pause→resume→pause cycle landed inside
+  the supplier's pause-counter put.  Against the PRE-FIX supplier (the
+  ``_prefix_wait`` shim below — a faithful copy of the code before
+  ``Orchestrator._wait_while_paused`` learned to revalidate) the trace
+  reproduces the torn guard: a round feeds while paused.  Against the
+  fixed supplier the same scenario passes under every explored
+  schedule.  This is the PR's acceptance artifact: the race is a
+  deterministic regression test forever.
+- Explorer smoke over the orchestrator scenario registry
+  (analysis/schedule.py): bounded-exhaustive on the small scenarios and
+  pinned-seed walks on the chaos ones, tier-1-sized budgets.
+"""
+
+import os
+
+import pytest
+
+from blance_tpu.analysis.schedule import (
+    CI_WALK_SEEDS,
+    SCENARIOS,
+    run_scenario_walks,
+)
+from blance_tpu.orchestrate.orchestrator import Orchestrator
+from blance_tpu.testing.sched import (
+    InvariantViolation,
+    explore,
+    load_trace,
+    replay,
+)
+
+TRACE_DIR = os.path.join(os.path.dirname(__file__), "traces")
+
+
+async def _prefix_wait(self):
+    """The pre-fix supplier pause wait: capture once, wait once.  A
+    resume+pause cycle during the pause-counter put closes the captured
+    channel — the wait returns immediately and the supplier feeds while
+    the orchestrator is logically paused."""
+    pause_ch = self._pause_ch
+    if pause_ch is None:
+        return
+    await self._bump("tot_run_supply_moves_pause")
+    await pause_ch.get()
+    await self._bump("tot_run_supply_moves_resume")
+
+
+@pytest.fixture
+def prefix_supplier(monkeypatch):
+    monkeypatch.setattr(Orchestrator, "_wait_while_paused", _prefix_wait)
+
+
+# -- the committed pause-guard trace -----------------------------------------
+
+
+def test_committed_trace_fails_on_prefix_code(prefix_supplier):
+    trace = load_trace(os.path.join(TRACE_DIR, "pause_cycle_guard.json"))
+    out = replay(SCENARIOS["pause_cycle_guard"].factory, trace,
+                 strict=True)
+    assert not out.ok
+    assert isinstance(out.error, InvariantViolation)
+    assert "paused" in str(out.error)
+
+
+def test_committed_trace_passes_on_fixed_code():
+    trace = load_trace(os.path.join(TRACE_DIR, "pause_cycle_guard.json"))
+    # strict=False: the fixed supplier legitimately changes the choice
+    # tree after the divergence point; the point is that the SCENARIO
+    # (whose assign asserts the pause guard) now holds.
+    out = replay(SCENARIOS["pause_cycle_guard"].factory, trace,
+                 strict=False)
+    assert out.ok, out.describe()
+
+
+def test_prefix_supplier_fails_under_exploration(prefix_supplier):
+    """Not just one lucky schedule: every interleaving of the scripted
+    cycle tears the pre-fix guard."""
+    rep = explore(SCENARIOS["pause_cycle_guard"].factory,
+                  branch_budget=1, max_schedules=100)
+    assert rep.violations, rep.summary()
+
+
+def test_fixed_supplier_explores_clean():
+    rep = explore(SCENARIOS["pause_cycle_guard"].factory,
+                  branch_budget=1, max_schedules=200)
+    assert rep.complete and rep.violations == [], rep.summary()
+
+
+def test_adversarial_repause_never_tears_the_feed_decision(monkeypatch):
+    """The strongest pause contract the supplier can honor is
+    DECISION-time: it never decides to feed a round while paused (a
+    pause landing after the decision is an in-flight move by reference
+    semantics — 'stop starting NEW assignments; in-flight moves
+    finish').  An adversarial consumer that re-pauses the instant it
+    observes any supplier resume bump — i.e. inside every rendezvous
+    window _wait_while_paused suspends in — must never catch the
+    supplier picking moves while _pause_ch is set.  The probe rides
+    _filter_next_plausible_moves_for_node, which runs synchronously
+    between the pause gate and feeder spawn."""
+    import asyncio
+
+    from blance_tpu.core.types import Partition, PartitionModelState
+    from blance_tpu.orchestrate import (
+        OrchestratorOptions,
+        orchestrate_moves,
+    )
+
+    model = {"primary": PartitionModelState(priority=0, constraints=0)}
+
+    orig = Orchestrator._filter_next_plausible_moves_for_node
+
+    def probed(self, node, arr):
+        if self._pause_ch is not None:
+            raise InvariantViolation(
+                "supplier decided to feed while paused")
+        return orig(self, node, arr)
+
+    monkeypatch.setattr(
+        Orchestrator, "_filter_next_plausible_moves_for_node", probed)
+
+    def factory():
+        async def scenario():
+            beg = {"p0": Partition("p0", {"primary": []}),
+                   "p1": Partition("p1", {"primary": []})}
+            end = {"p0": Partition("p0", {"primary": ["n1"]}),
+                   "p1": Partition("p1", {"primary": ["n1"]})}
+
+            async def assign(stop_ch, node, partitions, states, ops):
+                await asyncio.sleep(0)
+
+            o = orchestrate_moves(model, OrchestratorOptions(), ["n1"],
+                                  beg, end, assign)
+            o.pause_new_assignments()
+            repauses = 0
+            last_resume = 0
+
+            async def resume_later():
+                await asyncio.sleep(0.001)
+                o.resume_new_assignments()
+
+            resumers = [asyncio.ensure_future(resume_later())]
+            async for progress in o.progress_ch():
+                for e in progress.errors:
+                    if isinstance(e, InvariantViolation):
+                        raise e
+                if progress.tot_run_supply_moves_resume > last_resume \
+                        and repauses < 3:
+                    last_resume = progress.tot_run_supply_moves_resume
+                    repauses += 1
+                    o.pause_new_assignments()
+                    resumers.append(
+                        asyncio.ensure_future(resume_later()))
+            o.stop()
+            for t in resumers:
+                await t
+
+        return scenario()
+
+    rep = explore(factory, branch_budget=1, max_schedules=400)
+    assert rep.complete and rep.violations == [], (
+        rep.violations and rep.violations[0].error)
+
+
+# -- scenario registry smoke (tier-1-sized budgets) --------------------------
+
+
+def test_two_movers_three_partitions_bounded_exhaustive():
+    rep = explore(SCENARIOS["two_movers_three_partitions"].factory,
+                  branch_budget=1, max_schedules=500)
+    assert rep.complete and rep.violations == [], rep.summary()
+
+
+@pytest.mark.parametrize("name", [
+    "pause_resume_during_retry_backoff",
+    "stop_during_quarantine_probe",
+    "movers_race_breaker_trip",
+])
+def test_chaos_scenarios_pinned_seed_walks(name):
+    for seed, out in run_scenario_walks(SCENARIOS[name], CI_WALK_SEEDS):
+        assert out.ok, f"{name} seed={seed}: {out.describe()}"
+
+
+def test_walks_are_reproducible():
+    s = SCENARIOS["movers_race_breaker_trip"]
+    (seed_a, a), = run_scenario_walks(s, (11,))
+    (seed_b, b), = run_scenario_walks(s, (11,))
+    assert (a.choices, a.signature) == (b.choices, b.signature)
+
+
+def test_probe_scenario_actually_probes():
+    """The stop_during_quarantine_probe scenario must genuinely reach
+    the half-open window (structurally, not by luck) — otherwise it
+    stops guarding the code path it is named for."""
+    s = SCENARIOS["stop_during_quarantine_probe"]
+    (seed, out), = run_scenario_walks(s, (11,))
+    assert out.ok
+    assert out.result["stopped_during_probe"] == 1
+    assert out.result["trips"] >= 1
+
+
+def test_scenario_registry_shape():
+    names = set(SCENARIOS)
+    assert {"two_movers_three_partitions", "pause_cycle_guard",
+            "pause_resume_during_retry_backoff",
+            "stop_during_quarantine_probe",
+            "movers_race_breaker_trip"} <= names
+    exhaustive = [s for s in SCENARIOS.values() if s.exhaustive]
+    assert len(exhaustive) >= 2
+    assert len(CI_WALK_SEEDS) >= 3
+
+
+def test_schedule_cli_smoke(capsys):
+    from blance_tpu.analysis.schedule import main
+
+    rc = main(["--scenario", "pause_cycle_guard", "--budget", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pause_cycle_guard" in out and "OK" in out
+
+    rc = main(["--list"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "two_movers_three_partitions" in out
+
+
+def test_schedule_cli_emits_trace_on_violation(tmp_path, capsys,
+                                               prefix_supplier):
+    from blance_tpu.analysis.schedule import main
+
+    trace_dir = str(tmp_path / "traces")
+    rc = main(["--scenario", "pause_cycle_guard", "--budget", "0",
+               "--trace-dir", trace_dir])
+    capsys.readouterr()
+    assert rc == 1
+    files = os.listdir(trace_dir)
+    assert files, "violating schedule was not written as a trace"
+    tr = load_trace(os.path.join(trace_dir, sorted(files)[0]))
+    out = replay(SCENARIOS["pause_cycle_guard"].factory, tr, strict=True)
+    assert not out.ok

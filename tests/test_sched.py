@@ -1,0 +1,258 @@
+"""Tests for the deterministic schedule explorer itself
+(blance_tpu/testing/sched.py): the loop's determinism contract, the
+bounded-exhaustive enumeration's completeness on a toy with a known
+injected race, and the trace-file replay round trip."""
+
+import asyncio
+
+import pytest
+
+from blance_tpu.testing.sched import (
+    DeadlockError,
+    DeterministicLoop,
+    FifoPolicy,
+    InvariantViolation,
+    PrefixPolicy,
+    RandomWalkPolicy,
+    ReplayDivergence,
+    StepLimitExceeded,
+    Trace,
+    explore,
+    load_trace,
+    replay,
+    run_controlled,
+    save_trace,
+)
+
+
+class _Cell:
+    def __init__(self) -> None:
+        self.x = 0
+
+
+def racy_factory():
+    """Two tasks doing an unprotected read-modify-write across an await:
+    the classic lost update.  Some interleavings end with x == 1."""
+
+    async def scenario():
+        cell = _Cell()
+
+        async def incr():
+            tmp = cell.x
+            await asyncio.sleep(0)
+            cell.x = tmp + 1
+
+        t1 = asyncio.ensure_future(incr())
+        t2 = asyncio.ensure_future(incr())
+        await t1
+        await t2
+        if cell.x != 2:
+            raise InvariantViolation(f"lost update: x={cell.x}")
+        return cell.x
+
+    return scenario()
+
+
+def fixed_factory():
+    """The same increments serialized by a lock: no schedule loses one."""
+
+    async def scenario():
+        cell = _Cell()
+        lock = asyncio.Lock()
+
+        async def incr():
+            async with lock:
+                tmp = cell.x
+                await asyncio.sleep(0)
+                cell.x = tmp + 1
+
+        t1 = asyncio.ensure_future(incr())
+        t2 = asyncio.ensure_future(incr())
+        await t1
+        await t2
+        assert cell.x == 2
+        return cell.x
+
+    return scenario()
+
+
+# -- loop basics -------------------------------------------------------------
+
+
+def test_virtual_time_no_wall_clock():
+    """A 500 s sleep and a wait_for timeout both complete instantly in
+    virtual time; the loop clock advances to the timer deadlines."""
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(500.0)
+        try:
+            await asyncio.wait_for(asyncio.sleep(1000.0), timeout=2.5)
+            raise AssertionError("wait_for did not time out")
+        except asyncio.TimeoutError:
+            pass
+        return loop.time() - t0
+
+    out = run_controlled(lambda: scenario())
+    assert out.ok
+    assert out.result == pytest.approx(502.5)
+
+
+def test_deadlock_detection():
+    async def scenario():
+        await asyncio.get_running_loop().create_future()  # never set
+
+    out = run_controlled(lambda: scenario())
+    assert not out.ok and out.deadlock
+    assert isinstance(out.error, DeadlockError)
+
+
+def test_step_limit_detects_livelock():
+    async def scenario():
+        while True:
+            await asyncio.sleep(0)
+
+    out = run_controlled(lambda: scenario(), max_steps=500)
+    assert not out.ok and isinstance(out.error, StepLimitExceeded)
+
+
+def test_loop_local_task_names_are_deterministic():
+    """Task labels (and thus schedule signatures) must not depend on
+    asyncio's process-global Task-N counter."""
+
+    async def scenario():
+        async def child():
+            await asyncio.sleep(0)
+        await asyncio.ensure_future(child())
+
+    sig1 = run_controlled(lambda: scenario()).signature
+    # Burn some global Task names in a plain asyncio loop in between.
+    async def noise():
+        await asyncio.ensure_future(asyncio.sleep(0))
+    asyncio.run(noise())
+    sig2 = run_controlled(lambda: scenario()).signature
+    assert sig1 == sig2
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_seeded_walk_same_seed_same_schedule():
+    a = run_controlled(racy_factory, RandomWalkPolicy(5))
+    b = run_controlled(racy_factory, RandomWalkPolicy(5))
+    assert (a.choices, a.signature, a.steps, a.ok) == \
+        (b.choices, b.signature, b.steps, b.ok)
+
+
+def test_seeded_walks_differ_across_seeds():
+    outs = [run_controlled(racy_factory, RandomWalkPolicy(s))
+            for s in range(8)]
+    assert len({o.signature for o in outs}) > 1
+
+
+def test_prefix_policy_replays_exact_schedule():
+    walk = run_controlled(racy_factory, RandomWalkPolicy(3))
+    again = run_controlled(racy_factory, PrefixPolicy(walk.choices))
+    assert again.signature == walk.signature
+    assert again.ok == walk.ok
+
+
+# -- exhaustive completeness -------------------------------------------------
+
+
+def test_exhaustive_finds_injected_race_and_clean_twin_passes():
+    rep = explore(racy_factory, branch_budget=None, max_schedules=1000)
+    assert rep.complete and not rep.capped
+    assert rep.violations, "exhaustive enumeration missed the lost update"
+    assert all(v.error_type == "InvariantViolation"
+               for v in rep.violations)
+
+    rep2 = explore(fixed_factory, branch_budget=None, max_schedules=1000)
+    assert rep2.complete and rep2.violations == []
+
+
+def test_exhaustive_enumerates_distinct_schedules():
+    rep = explore(racy_factory, branch_budget=None, max_schedules=1000)
+    # FIFO + every deviation: the toy's full tree, each run distinct.
+    assert rep.schedules >= 4
+    # The FIFO baseline is always schedule #1; a violating schedule's
+    # choices replay to the same violation.
+    v = rep.violations[0]
+    out = run_controlled(racy_factory, PrefixPolicy(v.choices))
+    assert not out.ok and out.signature == v.signature
+
+
+def test_branch_budget_bounds_the_enumeration():
+    unbounded = explore(racy_factory, branch_budget=None,
+                        max_schedules=1000)
+    budget0 = explore(racy_factory, branch_budget=0, max_schedules=1000)
+    assert budget0.schedules == 1  # FIFO only
+    budget1 = explore(racy_factory, branch_budget=1, max_schedules=1000)
+    assert 1 < budget1.schedules <= unbounded.schedules
+
+
+def test_explore_cap_reports_incomplete():
+    rep = explore(racy_factory, branch_budget=None, max_schedules=2)
+    assert rep.capped and not rep.complete
+    assert rep.schedules == 2
+
+
+# -- trace round trip --------------------------------------------------------
+
+
+def test_trace_save_load_replay_round_trip(tmp_path):
+    rep = explore(racy_factory, branch_budget=None, max_schedules=1000)
+    v = rep.violations[0]
+    path = str(tmp_path / "toy.json")
+    save_trace(v.to_trace("toy", note="lost update"), path)
+
+    tr = load_trace(path)
+    assert tr.scenario == "toy"
+    assert tr.choices == v.choices
+    assert tr.candidate_counts == v.candidate_counts
+    assert "lost update" in tr.note
+
+    out = replay(racy_factory, tr, strict=True)
+    assert not out.ok
+    assert out.signature == v.signature
+    assert isinstance(out.error, InvariantViolation)
+
+
+def test_trace_version_and_key_validation(tmp_path):
+    import json
+
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        json.dump({"scenario": "s", "choices": [], "candidate_counts": [],
+                   "version": 999}, f)
+    with pytest.raises(ValueError, match="version"):
+        load_trace(path)
+    with open(path, "w") as f:
+        json.dump({"scenario": "s", "choices": [], "candidate_counts": [],
+                   "version": 1, "bogus": 1}, f)
+    with pytest.raises(ValueError, match="unknown trace keys"):
+        load_trace(path)
+
+
+def test_replay_divergence_on_structural_drift():
+    """A trace whose recorded choice exceeds the live candidate count
+    must raise ReplayDivergence (stale trace), not silently run."""
+    walk = run_controlled(racy_factory, RandomWalkPolicy(1))
+    bogus = Trace(scenario="toy", choices=[99],
+                  candidate_counts=[100])
+    with pytest.raises(ReplayDivergence):
+        replay(racy_factory, bogus)
+    # Strict replay with drifted candidate counts also raises.
+    drifted = Trace(scenario="toy", choices=list(walk.choices),
+                    candidate_counts=[c + 1 for c in
+                                      walk.candidate_counts])
+    if drifted.choices:  # toy has at least one choice point
+        with pytest.raises(ReplayDivergence):
+            replay(racy_factory, drifted, strict=True)
+
+
+def test_policy_base_and_fifo_choose_head():
+    loop = DeterministicLoop(FifoPolicy())
+    assert loop.time() == 0.0
+    assert FifoPolicy().choose(5) == 0
