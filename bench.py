@@ -401,6 +401,7 @@ def bench_chaos(P=96, N=12, seed=7, fail_rate=0.3):
         len(p.nodes_by_state.get("primary", [])) == 1
         and len(p.nodes_by_state.get("replica", [])) == 1
         for p in result.achieved_map.values())
+    slo = result.slo
     out = {
         "P": P, "N": N, "seed": seed, "fail_rate": fail_rate,
         "total_ms": round(total_ms, 1),
@@ -413,10 +414,103 @@ def bench_chaos(P=96, N=12, seed=7, fail_rate=0.3):
         "timeouts": rec.counters.get("orchestrate.timeouts", 0),
         "quarantine_trips": rec.counters.get(
             "orchestrate.quarantine_trips", 0),
+        # Online SLO accounting (obs/slo.py): the live gauges' final
+        # reading — availability/churn/lag plus per-node quarantine
+        # exposure — as streamed on the exposition endpoint mid-run.
+        "slo": {
+            "availability": round(slo.availability, 6),
+            "churn_ratio": round(slo.churn_ratio, 4),
+            "convergence_lag_ms": round(slo.convergence_lag_s * 1000, 2),
+            "moves_executed": slo.moves_executed,
+            "moves_failed": slo.moves_failed,
+            "min_moves": slo.min_moves,
+            "quarantine_exposure_s": {
+                n: round(v, 4)
+                for n, v in sorted(slo.quarantine_exposure_s.items())},
+        },
     }
     log(f"[chaos {P}x{N}] complete={complete} failures={out['failures']} "
         f"retries={out['retries']:.0f} trips={out['quarantine_trips']:.0f} "
-        f"recovery_rounds={out['recovery_rounds']} in {total_ms:.0f}ms")
+        f"recovery_rounds={out['recovery_rounds']} "
+        f"avail={out['slo']['availability']} "
+        f"churn={out['slo']['churn_ratio']} in {total_ms:.0f}ms")
+    return out
+
+
+def bench_costmodel(P=128, N=10, seed=5, fail_rate=0.25):
+    """Cost-model stage: calibrate per-(node, op) EWMA move costs from
+    the move-lifecycle spans of a chaos rebalance with a heterogeneous
+    data plane, and score the model's predicted-vs-actual relative
+    error online (each update falsifies the prediction that preceded
+    it).  Also round-trips the model through its JSON persistence —
+    the exact artifact ROADMAP item 2's critical-path scheduler loads."""
+    import asyncio
+    import tempfile
+
+    from blance_tpu import Partition, model
+    from blance_tpu.obs import CostModel, Recorder, use_recorder
+    from blance_tpu.orchestrate import FaultPlan, NodeFaults
+    from blance_tpu.orchestrate.orchestrator import OrchestratorOptions
+    from blance_tpu.rebalance import rebalance
+
+    nodes = [f"n{i:03d}" for i in range(N)]
+    m = model(primary=(0, 1), replica=(1, 1))
+    beg = {
+        f"{i:04d}": Partition(f"{i:04d}", {
+            "primary": [nodes[i % (N - 1)]],
+            "replica": [nodes[(i + 1) % (N - 1)]]})
+        for i in range(P)
+    }
+    plan = FaultPlan(seed=seed, nodes={
+        nodes[0]: NodeFaults(fail_rate=fail_rate),
+    })
+
+    # Heterogeneous per-(node, op) latency: node index sets the tier,
+    # op kind scales it — the structure the EWMA table must learn.
+    async def assign(stop_ch, node, partitions, states, ops):
+        tier = 1 + int(node[1:]) % 3
+        per_op = {"promote": 0.5, "demote": 0.5, "add": 1.0, "del": 0.25}
+        await asyncio.sleep(
+            0.002 * tier * max(per_op.get(op, 1.0) for op in ops))
+
+    rec = Recorder()
+    cm = CostModel(alpha=0.3, recorder=rec)
+    rec.add_sink(cm)
+    t0 = time.perf_counter()
+    with use_recorder(rec):
+        rebalance(
+            m, beg, nodes, [nodes[1]], [], plan.wrap(assign),
+            orchestrator_options=OrchestratorOptions(
+                move_timeout_s=1.0, max_retries=3, backoff_base_s=0.001,
+                quarantine_after=0),
+            backend="greedy")
+    total_ms = (time.perf_counter() - t0) * 1000
+
+    cal = cm.calibration()
+    # Persistence round trip: the scheduler-facing contract is that a
+    # reloaded model predicts exactly what the live one does.
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        path = f.name
+    cm.save(path)
+    reloaded = CostModel.load(path)
+    probes = list(cm.estimates())[:16] + [("never-seen", "add")]
+    roundtrip_ok = all(
+        cm.predict(n, op) == reloaded.predict(n, op) for n, op in probes)
+    os.unlink(path)
+
+    out = {
+        "P": P, "N": N, "seed": seed, "total_ms": round(total_ms, 1),
+        "observations": cal["observations"],
+        "scored": cal["scored"],
+        "estimates": cal["estimates"],
+        "p50_rel_err": round(cal.get("p50_rel_err", float("nan")), 4),
+        "p95_rel_err": round(cal.get("p95_rel_err", float("nan")), 4),
+        "roundtrip_ok": roundtrip_ok,
+    }
+    log(f"[costmodel {P}x{N}] {out['observations']} obs, "
+        f"{out['estimates']} (node,op) estimates, p50 rel err "
+        f"{out['p50_rel_err']}, p95 {out['p95_rel_err']}, "
+        f"roundtrip_ok={roundtrip_ok} in {total_ms:.0f}ms")
     return out
 
 
@@ -491,7 +585,8 @@ def bench_delta_replan(P, N):
 def obs_summary():
     """The Recorder's aggregates, floats rounded for the JSON artifact:
     per-span-name totals (phase attribution), counters (solver sweeps,
-    fallbacks, orchestrator progress mirror), histogram p50/p95."""
+    fallbacks, orchestrator progress mirror), gauges (SLO), histogram
+    p50/p95."""
     from blance_tpu.obs import get_recorder
 
     def r(x):
@@ -502,6 +597,7 @@ def obs_summary():
         "spans": {k: {kk: r(vv) for kk, vv in v.items()}
                   for k, v in s["spans"].items()},
         "counters": {k: r(v) for k, v in s["counters"].items()},
+        "gauges": {k: r(v) for k, v in s["gauges"].items()},
         "histograms": {k: {kk: r(vv) for kk, vv in v.items()}
                        for k, v in s["histograms"].items() if v},
     }
@@ -898,13 +994,23 @@ def _run_benchmarks(smoke, backend_note=None):
     save_progress(detail, "pipeline done")
 
     # Chaos stage: transition completion under a fixed injected fault
-    # rate — retries + quarantine + recovery replans end-to-end.
+    # rate — retries + quarantine + recovery replans end-to-end.  The
+    # stage's `slo` block is the online SLO accounting's final reading.
     try:
         detail["chaos"] = bench_chaos()
     except Exception as e:  # must not eat the solve numbers
         log(f"chaos stage failed ({type(e).__name__}: {first_line(e)})")
         detail["chaos_error"] = first_line(e)
     save_progress(detail, "chaos done")
+
+    # Cost-model stage: EWMA (node, op) move costs calibrated from the
+    # chaos run's move-lifecycle spans, scored predicted-vs-actual.
+    try:
+        detail["costmodel"] = bench_costmodel()
+    except Exception as e:  # must not eat the solve numbers
+        log(f"costmodel stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["costmodel_error"] = first_line(e)
+    save_progress(detail, "costmodel done")
 
     # Delta-replan stage: the incremental (warm-carry) replan against a
     # cold solve of the identical delta — cold vs warm sweeps and

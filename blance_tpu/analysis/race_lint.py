@@ -80,6 +80,21 @@ SHARED_STATE: dict[str, frozenset[str]] = {
     }),
     "Chan": frozenset({"_getters", "_putters", "_closed"}),
     "NextMoves": frozenset({"next", "next_done_ch", "failed_at"}),
+    # -- live telemetry plane (PR 6) ----------------------------------------
+    # SloTracker is mutated by every mover task (the on_batch observer
+    # hook) and read by the exposition server's snapshot path;
+    # CostModel's tables are updated from span-finish callbacks on the
+    # same tasks and read by the scheduler-facing predict().  Both rely
+    # on the single-atomic-window discipline: every mutator is a plain
+    # sync method with no await inside, so updates cannot interleave on
+    # the event loop.  The lint's RACE001/002 passes watch any future
+    # async method that breaks that discipline.
+    "SloTracker": frozenset({
+        "_placements", "_primaries", "_available", "moves_executed",
+        "moves_failed", "_min_moves", "_t_last_progress", "_health",
+    }),
+    "CostModel": frozenset({"_est", "_op_est", "_global", "_errors",
+                            "_n_scored"}),
 }
 
 # Container mutators: a call to one of these on a shared attribute is a

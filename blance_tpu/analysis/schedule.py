@@ -493,6 +493,77 @@ async def _movers_race_breaker_trip() -> dict[str, int]:
             "trips": last.tot_quarantine_trips}
 
 
+async def _slo_gauges_under_chaos() -> dict[str, int]:
+    """The live-telemetry plane under chaos: every interleaving must
+    keep the SLO gauges well-formed — availability within [0, 1] at
+    every progress snapshot, the executed-move count monotone,
+    convergence lag non-negative — and at the end the tracker's
+    incrementally maintained view must agree EXACTLY with both the
+    independently logged assign batches and a from-scratch availability
+    recompute off ``achieved_map()``.  The whole run (orchestrator
+    clocks included) rides a virtual-time Recorder, so gauge values are
+    pure functions of the schedule."""
+    from ..obs import Recorder, use_recorder
+    from ..obs.slo import SloTracker
+
+    loop = asyncio.get_running_loop()
+    beg = _pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+    end = _pm({"p0": {"primary": ["b"]}, "p1": {"primary": ["b"]},
+               "p2": {"primary": ["bad"]}, "p3": {"primary": ["flaky"]}})
+    plan = FaultPlan(seed=13, nodes={
+        "bad": NodeFaults(dead=True),
+        "flaky": NodeFaults(fail_rate=0.5),
+    })
+    executed: list[tuple[str, tuple[str, ...], tuple[str, ...],
+                         tuple[str, ...]]] = []
+    with use_recorder(Recorder(clock=loop.time)) as rec:
+        slo = SloTracker(beg, primary_states=("primary",),
+                         clock=loop.time, recorder=rec)
+        o = orchestrate_moves(
+            _MODEL,
+            OrchestratorOptions(move_timeout_s=0.25, max_retries=2,
+                                backoff_base_s=0.002, quarantine_after=2,
+                                probe_after_s=60.0),
+            ["a", "b", "bad", "flaky"], beg, end,
+            plan.wrap(_logging_assign(executed)), move_observers=(slo,))
+        o.visit_next_moves(lambda m: slo.set_min_moves(
+            sum(len(nm.moves) for nm in m.values())))
+        slo.attach_health(o.health)
+        inv = ProgressInvariants(o, ft_errors_structured=True)
+        prev_executed = 0
+        async for progress in o.progress_ch():
+            inv.observe(progress)
+            a = slo.availability()
+            if not 0.0 <= a <= 1.0:
+                raise InvariantViolation(f"availability out of [0,1]: {a}")
+            if slo.moves_executed < prev_executed:
+                raise InvariantViolation(
+                    f"executed-move count regressed: {prev_executed} -> "
+                    f"{slo.moves_executed}")
+            prev_executed = slo.moves_executed
+            if slo.convergence_lag_s() < 0.0:
+                raise InvariantViolation("negative convergence lag")
+            if slo.churn_ratio() < 0.0:
+                raise InvariantViolation("negative churn")
+        o.stop()
+        inv.finish(executed=executed)
+        logged = sum(len(parts) for _node, parts, _s, _o in executed)
+        if slo.moves_executed != logged:
+            raise InvariantViolation(
+                f"tracker executed {slo.moves_executed} != {logged} "
+                f"batches logged by the assign callback")
+        achieved = o.achieved_map()
+        recomputed = sum(
+            1 for p in achieved.values()
+            if p.nodes_by_state.get("primary")) / len(achieved)
+        if abs(recomputed - slo.availability()) > 1e-12:
+            raise InvariantViolation(
+                f"incremental availability {slo.availability()} diverges "
+                f"from achieved-map recompute {recomputed}")
+    return {"snapshots": inv.snapshots, "executed": logged,
+            "failed": slo.moves_failed}
+
+
 SCENARIOS: dict[str, Scenario] = {
     s.name: s for s in (
         Scenario(
@@ -522,6 +593,11 @@ SCENARIOS: dict[str, Scenario] = {
             doc="two movers race breaker trips on two dead nodes "
                 "(seeded chaos walks)",
             factory=_movers_race_breaker_trip),
+        Scenario(
+            name="slo_gauges_under_chaos",
+            doc="SLO gauges stay well-formed and agree with the "
+                "achieved map under chaos (seeded chaos walks)",
+            factory=_slo_gauges_under_chaos),
     )
 }
 

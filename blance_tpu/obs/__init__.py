@@ -27,11 +27,31 @@ Sinks decide retention (``sinks.InMemorySink``, ``sinks.JsonlSink``,
 Perfetto-loadable file; ``utils.trace.PhaseTimer`` remains as a thin
 compatibility shim whose phases are recorded as spans here.
 
+The LIVE telemetry plane (PR 6) layers on the same Recorder:
+``expo.MetricsServer`` serves Prometheus text format from periodic
+Recorder snapshots (``expo.default_registry()`` is the one declarative
+table of every metric); ``slo.SloTracker`` computes online SLO gauges
+(availability, churn, convergence lag, quarantine exposure) during a
+rebalance; ``costmodel.CostModel`` learns per-(node, op) EWMA move
+costs from the move-lifecycle spans and persists them as JSON for the
+critical-path scheduler.
+
 See docs/OBSERVABILITY.md for the architecture tour.
 """
 
 from .chrome import ChromeTraceSink, trace, write_chrome_trace
+from .costmodel import CostModel
+from .expo import (
+    Metric,
+    MetricsRegistry,
+    MetricsServer,
+    default_registry,
+    parse_prometheus,
+    render_prometheus,
+    scrape,
+)
 from .recorder import (
+    DEFAULT_BUCKETS,
     Recorder,
     Span,
     get_recorder,
@@ -41,10 +61,12 @@ from .recorder import (
     use_recorder,
 )
 from .sinks import InMemorySink, JsonlSink, span_to_dict
+from .slo import MoveObserver, SloSummary, SloTracker
 
 __all__ = [
     "Recorder",
     "Span",
+    "DEFAULT_BUCKETS",
     "get_recorder",
     "set_recorder",
     "use_recorder",
@@ -56,4 +78,15 @@ __all__ = [
     "ChromeTraceSink",
     "write_chrome_trace",
     "trace",
+    "Metric",
+    "MetricsRegistry",
+    "MetricsServer",
+    "default_registry",
+    "render_prometheus",
+    "parse_prometheus",
+    "scrape",
+    "MoveObserver",
+    "SloSummary",
+    "SloTracker",
+    "CostModel",
 ]

@@ -1,0 +1,12 @@
+"""``python -m blance_tpu.obs`` — the exposition CLI (obs/expo.py).
+
+A thin delegate so the CI obs-smoke step can invoke the package without
+the 'found in sys.modules' RuntimeWarning that ``-m blance_tpu.obs.expo``
+triggers (the package __init__ imports expo eagerly)."""
+
+import sys
+
+from .expo import main
+
+if __name__ == "__main__":
+    sys.exit(main())

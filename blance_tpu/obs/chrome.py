@@ -12,8 +12,12 @@ object format (the stable subset both viewers load):
   overlap live slices on their lane, which ``"X"`` slices cannot express;
 - ``"M"`` metadata events naming each lane, so Perfetto shows
   "mover:n0001" instead of a bare number;
-- ``"C"`` counter events for the recorder's final counter values, emitted
-  at the trace end so the metrics and the timeline ship in one file.
+- ``"C"`` counter events: one time-stamped sample per counter UPDATE
+  (the sink implements the Recorder's live ``counter`` hook), so
+  Perfetto renders counter tracks evolving on the same timeline as the
+  spans — retries ramping during a flaky stretch, move totals climbing
+  batch by batch — plus one final sample per counter at the trace end
+  so the track closes at its end-of-run value.
 
 ``trace(...)`` is the one-call wrapper (bench.py ``--trace-out`` uses it):
 it attaches the sink, runs the body under ``device_profile`` when a TPU log
@@ -41,11 +45,19 @@ class ChromeTraceSink:
     def __init__(self, recorder: Optional[Recorder] = None) -> None:
         self._t0 = (recorder or get_recorder()).t0
         self._spans: list[Span] = []
+        self._counter_samples: list[tuple[float, str, float]] = []
         self._lock = threading.Lock()
 
     def span(self, sp: Span) -> None:
         with self._lock:
             self._spans.append(sp)
+
+    def counter(self, name: str, value: float, t: float) -> None:
+        """Live counter sample (the Recorder calls this on every
+        ``count``): becomes one time-stamped "C" event, so the counter
+        renders as a track over time, not just a final value."""
+        with self._lock:
+            self._counter_samples.append((t, name, value))
 
     def close(self) -> None:
         pass
@@ -54,6 +66,7 @@ class ChromeTraceSink:
         """The traceEvents list (see module docstring for the shapes)."""
         with self._lock:
             spans = list(self._spans)
+            samples = list(self._counter_samples)
         pid = os.getpid()
         tids: dict[str, int] = {}
         events: list[dict] = []
@@ -89,6 +102,16 @@ class ChromeTraceSink:
                     "name": sp.name, "ph": "X", "ts": ts, "dur": dur,
                     "pid": pid, "tid": tids[sp.task], "args": args,
                 })
+        # Live counter samples, time-ordered: the evolving track.
+        for t, name, value in sorted(samples):
+            ts = max(t - self._t0, 0.0) * 1e6
+            t_last = max(t_last, ts)
+            events.append({
+                "name": name, "ph": "C", "ts": ts, "pid": pid,
+                "args": {"value": value},
+            })
+        # Final values close every track at the trace end (and cover
+        # counters bumped before the sink was attached).
         for name, value in sorted((counters or {}).items()):
             events.append({
                 "name": name, "ph": "C", "ts": t_last, "pid": pid,

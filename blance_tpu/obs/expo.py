@@ -1,0 +1,595 @@
+"""Streaming metrics exposition: a Prometheus text-format endpoint.
+
+PR 1's obs core was post-hoc — spans and histograms readable only after
+the run.  This module makes telemetry a live subsystem: an asyncio HTTP
+endpoint serves the Recorder's aggregates in the Prometheus text format
+(version 0.0.4, the stable subset every scraper parses), so a
+long-running rebalance serving real traffic is observable WHILE it
+executes.  Three pieces:
+
+- :class:`MetricsRegistry` — the single declarative table of every
+  metric the pipeline emits: internal dotted name, type (counter /
+  gauge / histogram), and help string.  ``default_registry()`` builds
+  the blance_tpu table (plan, moves, orchestrate, rebalance, slo,
+  costmodel groups; the ``orchestrate.tot_*`` progress mirror is
+  generated from ``OrchestratorProgress``'s own fields so the mirror
+  can never drift from the dataclass).  The drift-guard test pins this
+  table against both the names actually emitted during a pipeline run
+  and the metric table in docs/OBSERVABILITY.md.
+- :func:`render_prometheus` — one Recorder snapshot rendered as
+  exposition text.  Counters get a ``_total`` suffix; histograms render
+  cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` series straight
+  off the Recorder's EXACT bucket counts; gauges render last-value
+  samples, including labeled families (a gauge key of the form
+  ``name{label="x"}`` carries its label set through verbatim).  Every
+  DECLARED metric is rendered (zero-valued when never emitted), so a
+  scrape is a complete, stable schema from the first request.
+- :class:`MetricsServer` — a minimal asyncio HTTP/1.1 server for
+  ``GET /metrics``.  Renders are throttled to one Recorder snapshot per
+  ``min_interval_s`` (scrapes between snapshots serve the cached text),
+  and ``collectors`` callables run before each snapshot — the SLO
+  tracker's ``publish`` hook plugs in there so time-derived gauges
+  (convergence lag) are fresh per snapshot.
+
+Pure asyncio + stdlib; no sockets are touched until ``start()``, and
+``render_prometheus`` needs no event loop at all — the virtual-time
+tests drive it directly under ``DeterministicLoop``.
+
+CLI (the CI ``obs-smoke`` step)::
+
+    python -m blance_tpu.obs.expo --smoke
+
+runs a seeded chaos rebalance (30% flaky + a dead node) with the
+endpoint live, scrapes it mid-run and again later, and asserts the
+output parses, counters are monotone between scrapes, every registry
+metric is present, and availability stays in [0, 1].
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional, Sequence
+
+from .recorder import Recorder, get_recorder
+
+__all__ = [
+    "Metric",
+    "MetricsRegistry",
+    "default_registry",
+    "render_prometheus",
+    "parse_prometheus",
+    "MetricsServer",
+    "scrape",
+    "main",
+]
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+@dataclass(frozen=True)
+class Metric:
+    """One declared metric: internal dotted name, type, help string."""
+
+    name: str  # e.g. "orchestrate.move_latency_s"
+    kind: str  # "counter" | "gauge" | "histogram"
+    help: str
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"metric {self.name!r}: unknown kind "
+                             f"{self.kind!r} (want one of {_KINDS})")
+
+
+def _prom_base(name: str) -> str:
+    """Dotted internal name -> Prometheus-legal base name."""
+    return "blance_" + name.replace(".", "_").replace("-", "_")
+
+
+class MetricsRegistry:
+    """The declarative metric table the exposition renders from.
+
+    One entry per (name, kind) — ``plan.solve.sweeps`` is legitimately
+    both a counter (total passes) and a histogram (passes per solve),
+    and the two render under distinct Prometheus names (``_total`` vs
+    ``_bucket``/``_sum``/``_count``)."""
+
+    def __init__(self, metrics: Iterable[Metric]) -> None:
+        self._by_key: dict[tuple[str, str], Metric] = {}
+        seen_prom: dict[str, tuple[str, str]] = {}
+        for m in metrics:
+            key = (m.name, m.kind)
+            if key in self._by_key:
+                raise ValueError(f"duplicate metric declaration {key}")
+            pname = self.prom_name(m)
+            if pname in seen_prom:
+                raise ValueError(
+                    f"metric {key} renders to Prometheus name {pname!r} "
+                    f"already taken by {seen_prom[pname]}")
+            seen_prom[pname] = key
+            self._by_key[key] = m
+
+    def metrics(self) -> list[Metric]:
+        return sorted(self._by_key.values(), key=lambda m: (m.name, m.kind))
+
+    def declared(self, name: str, kind: str) -> bool:
+        return (name, kind) in self._by_key
+
+    @staticmethod
+    def prom_name(metric: Metric) -> str:
+        base = _prom_base(metric.name)
+        return base + "_total" if metric.kind == "counter" else base
+
+    def names(self, kind: Optional[str] = None) -> set[str]:
+        return {n for (n, k) in self._by_key if kind is None or k == kind}
+
+    def undeclared(self, recorder: Recorder) -> list[str]:
+        """Every (kind, name) the recorder holds that this registry does
+        not declare — the drift-guard's 'no undeclared emissions' check.
+        Labeled gauge keys are matched on their base name."""
+        out: list[str] = []
+        with recorder._lock:  # consistent snapshot vs concurrent emits
+            counters = list(recorder.counters)
+            gauges = list(recorder.gauges)
+            hists = list(recorder._hist_stats)
+        for name in counters:
+            if not self.declared(name, "counter"):
+                out.append(f"counter:{name}")
+        for key in gauges:
+            base = key.split("{", 1)[0]
+            if not self.declared(base, "gauge"):
+                out.append(f"gauge:{base}")
+        for name in hists:
+            if not self.declared(name, "histogram"):
+                out.append(f"histogram:{name}")
+        return sorted(set(out))
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+
+
+def default_registry() -> MetricsRegistry:
+    """The blance_tpu metric table, built lazily (the ``orchestrate.tot_*``
+    mirror enumerates ``OrchestratorProgress``'s fields, and importing
+    orchestrate at module-import time would be circular: orchestrate
+    itself imports obs)."""
+    global _REGISTRY
+    if _REGISTRY is not None:
+        return _REGISTRY
+    from ..orchestrate.orchestrator import OrchestratorProgress
+
+    metrics: list[Metric] = [
+        # -- plan ------------------------------------------------------------
+        Metric("plan.solve.calls", "counter",
+               "solver invocations (cold solves + warm repair attempts)"),
+        Metric("plan.solve.sweeps", "counter",
+               "converged-loop passes executed, summed over all solves"),
+        Metric("plan.solve.sweeps", "histogram",
+               "converged-loop passes per solve"),
+        Metric("plan.solve.carry_hit", "counter",
+               "warm replans whose carry-seeded repair was accepted"),
+        Metric("plan.solve.carry_miss", "counter",
+               "replans with no usable solver carry"),
+        Metric("plan.solve.warm_fallback", "counter",
+               "warm repairs declined or failed, falling back to cold"),
+        Metric("plan.solve.dirty_fraction", "histogram",
+               "fraction of partitions each delta replan marked dirty"),
+        Metric("plan.engine_fallback", "counter",
+               "score-engine fallbacks (fused -> matrix)"),
+        Metric("plan.greedy.candidates", "histogram",
+               "candidates scored per greedy (partition, state) pick"),
+        # -- moves -----------------------------------------------------------
+        Metric("moves.diff_partitions", "counter",
+               "partitions diffed by the batched device move calculus"),
+        Metric("moves.irregular_partitions", "counter",
+               "partitions routed to the host loop by the batched diff"),
+        Metric("moves.total_ops", "counter",
+               "move operations produced by the batched diff"),
+        # -- orchestrate (beyond the tot_* mirror) ---------------------------
+        Metric("orchestrate.retries", "counter",
+               "backoff-scheduled retry attempts"),
+        Metric("orchestrate.retry_backoff_s", "histogram",
+               "seconds each scheduled retry backed off"),
+        Metric("orchestrate.timeouts", "counter",
+               "async assign callbacks cancelled at move_timeout_s"),
+        Metric("orchestrate.quarantine_trips", "counter",
+               "circuit-breaker entries into quarantine"),
+        Metric("orchestrate.move_failures", "counter",
+               "structured MoveFailures recorded (abandoned moves)"),
+        Metric("orchestrate.missing_mover", "counter",
+               "moves targeting a node with no mover (outside nodes_all)"),
+        Metric("orchestrate.errors", "counter",
+               "errors folded into the progress stream (legacy aborts, "
+               "mover exits)"),
+        Metric("orchestrate.task_exceptions", "counter",
+               "orchestration tasks that died with an escaped exception"),
+        Metric("orchestrate.move_latency_s", "histogram",
+               "per-partition-move callback latency (batch exec amortized "
+               "across its moves)"),
+        # -- rebalance -------------------------------------------------------
+        Metric("rebalance.recovery_rounds", "counter",
+               "failure-aware recovery replan rounds entered"),
+        # -- slo (obs/slo.py; formulas in docs/OBSERVABILITY.md) -------------
+        Metric("slo.partition_availability", "gauge",
+               "fraction of partitions with at least one serving primary"),
+        Metric("slo.churn_ratio", "gauge",
+               "moves executed / minimum necessary (the primary plan)"),
+        Metric("slo.convergence_lag_s", "gauge",
+               "seconds since the last successfully executed move"),
+        Metric("slo.moves_executed", "gauge",
+               "partition moves successfully executed so far (monotone)"),
+        Metric("slo.moves_failed", "gauge",
+               "partition moves that failed or were rejected (monotone)"),
+        Metric("slo.min_moves", "gauge",
+               "the primary plan's move count (the churn denominator)"),
+        Metric("slo.quarantined_nodes", "gauge",
+               "nodes currently quarantined or half-open"),
+        Metric("slo.quarantine_exposure_s", "gauge",
+               "cumulative seconds each node has spent quarantined "
+               "(labeled per node)"),
+        # -- costmodel (obs/costmodel.py) ------------------------------------
+        Metric("costmodel.updates", "counter",
+               "EWMA cost-model updates from move-lifecycle spans"),
+        Metric("costmodel.rel_err", "histogram",
+               "relative error of the cost prediction vs the observed "
+               "per-move cost, at update time"),
+    ]
+    metrics.extend(
+        Metric("orchestrate." + name, "counter",
+               f"progress counter mirror of OrchestratorProgress.{name}")
+        for name in OrchestratorProgress().__dict__
+        if name != "errors")
+    _REGISTRY = MetricsRegistry(metrics)
+    return _REGISTRY
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def _fmt(v: float) -> str:
+    """Deterministic sample formatting: integral floats render as ints
+    (the common counter case), everything else as repr (full precision,
+    stable across platforms)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2 ** 53:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(recorder: Optional[Recorder] = None,
+                      registry: Optional[MetricsRegistry] = None) -> str:
+    """One Recorder snapshot as Prometheus text format (0.0.4).
+
+    Registry-driven: every declared metric appears (HELP + TYPE + at
+    least one sample, zero-valued when never emitted), so the scrape
+    schema is complete and stable from the first request.  Recorder
+    names NOT in the registry are deliberately omitted — the drift
+    guard makes that set empty for the shipped pipeline."""
+    rec = recorder if recorder is not None else get_recorder()
+    reg = registry if registry is not None else default_registry()
+    with rec._lock:  # the Recorder is counted from threads too; copying
+        counters = dict(rec.counters)  # an unlocked dict mid-insert can
+        gauges = dict(rec.gauges)  # raise 'changed size during iteration'
+    lines: list[str] = []
+    for m in reg.metrics():
+        pname = reg.prom_name(m)
+        lines.append(f"# HELP {pname} {m.help}")
+        lines.append(f"# TYPE {pname} {m.kind}")
+        if m.kind == "counter":
+            lines.append(f"{pname} {_fmt(counters.get(m.name, 0))}")
+        elif m.kind == "gauge":
+            labeled = sorted(k for k in gauges
+                             if k.startswith(m.name + "{"))
+            if m.name in gauges:
+                lines.append(f"{pname} {_fmt(gauges[m.name])}")
+            for key in labeled:
+                lines.append(f"{pname}{key[len(m.name):]} "
+                             f"{_fmt(gauges[key])}")
+            if m.name not in gauges and not labeled:
+                lines.append(f"{pname} 0")
+        else:  # histogram
+            hb = rec.histogram_buckets(m.name)
+            if hb is None:
+                lines.append(f'{pname}_bucket{{le="+Inf"}} 0')
+                lines.append(f"{pname}_sum 0")
+                lines.append(f"{pname}_count 0")
+            else:
+                bounds, cum, count, total = hb
+                for b, c in zip(bounds, cum):
+                    lines.append(f'{pname}_bucket{{le="{_fmt(b)}"}} {c}')
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {cum[-1]}')
+                lines.append(f"{pname}_sum {_fmt(total)}")
+                lines.append(f"{pname}_count {count}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> tuple[dict[str, float], dict[str, str]]:
+    """Parse exposition text back into (samples, types).
+
+    ``samples`` is keyed by the full sample name INCLUDING any label
+    set (``blance_x_bucket{le="1"}``); ``types`` maps base metric name
+    to its declared type.  Raises ValueError on any line that is
+    neither a comment nor a well-formed sample — the CI smoke's
+    'parseable' assertion."""
+    samples: dict[str, float] = {}
+    types: dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in _KINDS:
+                raise ValueError(f"line {lineno}: malformed TYPE: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        name, sep, value = line.rpartition(" ")
+        if not sep or not name:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        try:
+            samples[name] = float(value)
+        except ValueError as e:
+            raise ValueError(
+                f"line {lineno}: bad sample value {value!r}") from e
+    return samples, types
+
+
+# -- the asyncio endpoint ----------------------------------------------------
+
+
+class MetricsServer:
+    """Minimal asyncio HTTP/1.1 server for ``GET /metrics``.
+
+    ``collectors`` run before each snapshot (e.g. ``SloTracker.publish``
+    refreshing time-derived gauges); renders are throttled to one per
+    ``min_interval_s`` with scrapes in between served from the cached
+    text, so a tight scrape loop cannot turn the recorder lock into a
+    hot path."""
+
+    def __init__(self, recorder: Optional[Recorder] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 min_interval_s: float = 0.25,
+                 collectors: Sequence[Callable[[], None]] = ()) -> None:
+        self._recorder = recorder
+        self._registry = registry
+        self._host = host
+        self._requested_port = port
+        self._min_interval_s = min_interval_s
+        self._collectors = tuple(collectors)
+        self._server: Optional[asyncio.Server] = None
+        self._cached: Optional[str] = None
+        self._cached_at: Optional[float] = None
+
+    # -- snapshotting --------------------------------------------------------
+
+    def render(self) -> str:
+        """A FRESH snapshot (collectors + render), bypassing the cache.
+        Loop-free: usable directly under DeterministicLoop tests."""
+        for collect in self._collectors:
+            collect()
+        rec = self._recorder if self._recorder is not None \
+            else get_recorder()
+        return render_prometheus(rec, self._registry)
+
+    def _snapshot(self) -> str:
+        rec = self._recorder if self._recorder is not None \
+            else get_recorder()
+        now = rec.now()
+        if self._cached is None or self._cached_at is None or \
+                now - self._cached_at >= self._min_interval_s:
+            self._cached = self.render()
+            self._cached_at = now
+        return self._cached
+
+    # -- server lifecycle ----------------------------------------------------
+
+    async def start(self) -> None:
+        if self._server is not None:
+            raise RuntimeError("MetricsServer already started")
+        self._server = await asyncio.start_server(
+            self._handle, self._host, self._requested_port)
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("MetricsServer not started")
+        sock = self._server.sockets[0]
+        return int(sock.getsockname()[1])
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 10.0)
+            while True:  # drain headers to the blank line
+                header = await asyncio.wait_for(reader.readline(), 10.0)
+                if header in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.split()
+            path = parts[1].decode("latin-1") if len(parts) >= 2 else ""
+            if parts and parts[0] != b"GET":
+                status, body = "405 Method Not Allowed", b"method not allowed\n"
+            elif path in ("/metrics", "/"):
+                status, body = "200 OK", self._snapshot().encode()
+            else:
+                status, body = "404 Not Found", b"not found\n"
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (ConnectionError, asyncio.TimeoutError, OSError):
+            pass  # a dropped/slow scraper is the scraper's problem
+        finally:
+            writer.close()
+
+
+async def scrape(host: str, port: int, path: str = "/metrics",
+                 timeout_s: float = 10.0) -> str:
+    """Minimal asyncio scrape client (the CI smoke and tests use it;
+    production scrapes come from a real Prometheus)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                     f"Connection: close\r\n\r\n".encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(-1), timeout_s)
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n", 1)[0]
+    if b" 200 " not in status + b" ":
+        raise RuntimeError(f"scrape failed: {status.decode('latin-1')}")
+    return body.decode()
+
+
+# -- CI smoke ----------------------------------------------------------------
+
+
+async def _smoke_async(fail_rate: float = 0.3, seed: int = 7) -> int:
+    """Chaos rebalance with the endpoint live: scrape twice mid-flight,
+    once after, and assert the acceptance contract (parseable output,
+    every registry metric present, monotone counters, availability in
+    [0, 1]).  Returns a process exit code."""
+    from ..core.types import Partition, PartitionModelState
+    from ..orchestrate.faults import FaultPlan, NodeFaults
+    from ..orchestrate.orchestrator import OrchestratorOptions
+    from ..rebalance import rebalance_async
+    from .recorder import use_recorder
+    from .slo import SloTracker
+
+    P, N = 64, 8
+    nodes = [f"n{i:03d}" for i in range(N)]
+    live, dead = nodes[:-1], nodes[-1]
+    model = {"primary": PartitionModelState(priority=0, constraints=1),
+             "replica": PartitionModelState(priority=1, constraints=1)}
+    beg = {
+        f"{i:04d}": Partition(f"{i:04d}", {
+            "primary": [live[i % len(live)]],
+            "replica": [live[(i + 1) % len(live)]]})
+        for i in range(P)
+    }
+    plan = FaultPlan(seed=seed, nodes={
+        dead: NodeFaults(dead=True),
+        nodes[0]: NodeFaults(fail_rate=fail_rate),
+        nodes[1]: NodeFaults(fail_rate=fail_rate),
+    })
+
+    async def assign(stop_ch: object, node: str, partitions: list[str],
+                     states: list[str], ops: list[str]) -> None:
+        await asyncio.sleep(0.001)  # keep the run in flight across scrapes
+
+    failures: list[str] = []
+
+    def check(cond: bool, what: str) -> None:
+        if not cond:
+            failures.append(what)
+        print(f"  {'ok' if cond else 'FAIL'}: {what}", file=sys.stderr)
+
+    rec = Recorder()
+    with use_recorder(rec):
+        slo = SloTracker(beg, primary_states=("primary",), clock=rec.now,
+                         recorder=rec)
+        server = MetricsServer(recorder=rec, collectors=(slo.publish,),
+                               min_interval_s=0.01)
+        await server.start()
+        try:
+            loop = asyncio.get_running_loop()
+            # Decommission one live node AND add the dead one: the
+            # decommission forces real (retried-through-the-flakes)
+            # migrations between live nodes, while every move onto the
+            # dead node fails into quarantine + recovery — so the scrape
+            # sees both executed moves and failures.
+            run = loop.create_task(rebalance_async(
+                model, beg, nodes, [live[2]], [dead], plan.wrap(assign),
+                # Generous deadline/retry budget: on a loaded CI host
+                # only the SCRIPTED faults may fail moves — an innocent
+                # callback stalled by scheduling jitter must not trip
+                # quarantine and sink the final-availability assertion.
+                orchestrator_options=OrchestratorOptions(
+                    move_timeout_s=5.0, max_retries=6,
+                    backoff_base_s=0.002, quarantine_after=3,
+                    probe_after_s=60.0),
+                max_recovery_rounds=3, backend="greedy", slo=slo))
+            await asyncio.sleep(0.05)
+            text1 = await scrape("127.0.0.1", server.port)
+            await asyncio.sleep(0.05)
+            text2 = await scrape("127.0.0.1", server.port)
+            result = await run
+            text3 = await scrape("127.0.0.1", server.port)
+        finally:
+            await server.stop()
+
+    s1, t1 = parse_prometheus(text1)
+    s2, _t2 = parse_prometheus(text2)
+    s3, _t3 = parse_prometheus(text3)
+    print(f"obs-smoke: scraped {len(s1)} -> {len(s2)} -> {len(s3)} "
+          f"samples; rebalance failures={len(result.failures)} "
+          f"quarantined={result.quarantined_nodes}", file=sys.stderr)
+
+    reg = default_registry()
+    missing = [reg.prom_name(m) for m in reg.metrics()
+               if reg.prom_name(m) not in t1]
+    check(not missing, f"every registry metric exposed (missing: "
+                       f"{missing[:5]})")
+    counter_names = {reg.prom_name(m) for m in reg.metrics()
+                     if m.kind == "counter"}
+    regressed = [n for n in counter_names
+                 if not (s1.get(n, 0) <= s2.get(n, 0) <= s3.get(n, 0))]
+    check(not regressed, f"counters monotone across scrapes (regressed: "
+                         f"{regressed[:5]})")
+    avail = "blance_slo_partition_availability"
+    check(all(0.0 <= s[avail] <= 1.0 for s in (s1, s2, s3)),
+          "availability within [0, 1] on every scrape")
+    check(s3[avail] == 1.0, "final availability is 1.0 (chaos run "
+                            "completed on the survivors)")
+    # Churn can land under 1.0 here: abandoned moves are never executed
+    # and the recovery replan (dead placements presumed lost) owes fewer
+    # moves than the primary plan did.  Positive just means the gauge is
+    # wired.
+    check(s3["blance_slo_churn_ratio"] > 0.0,
+          "churn ratio positive and published")
+    check(s3["blance_slo_moves_executed"] > 0,
+          "executed-move gauge advanced")
+    check(s3["blance_orchestrate_move_failures_total"] > 0,
+          "chaos actually injected failures")
+    if failures:
+        print(f"obs-smoke: FAIL ({len(failures)} checks)", file=sys.stderr)
+        return 1
+    print("obs-smoke: OK", file=sys.stderr)
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m blance_tpu.obs.expo",
+        description="Prometheus exposition endpoint for blance_tpu "
+                    "telemetry (docs/OBSERVABILITY.md)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: chaos rebalance with the endpoint "
+                         "live; scrape + assert, exit nonzero on failure")
+    ap.add_argument("--render", action="store_true",
+                    help="render one snapshot of the process recorder "
+                         "to stdout and exit")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return asyncio.run(_smoke_async())
+    if args.render:
+        print(render_prometheus(), end="")
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,28 +12,39 @@ per-move lifecycle).  Three primitives:
   lifecycles whose start predates the code that observes them — e.g. a
   move request's queue-wait time, measured by the mover that dequeues it.
 - **Counters**: monotonic named floats (``count``).
+- **Gauges**: last-value-wins named floats (``set_gauge``) — the online
+  SLO accounting (``obs.slo``) publishes availability/churn/lag here and
+  the exposition endpoint (``obs.expo``) serves them.
 - **Histograms**: named value series (``observe``) summarized by
   nearest-rank percentiles (p50/p95) — per-move latency, solver sweep
-  counts, greedy candidate-list sizes.
+  counts, greedy candidate-list sizes — plus EXACT cumulative bucket
+  counts over fixed log-spaced bounds, which is what the Prometheus
+  exposition's ``_bucket``/``_sum``/``_count`` series are built from.
 
 The Recorder itself keeps only O(#names) aggregate state: span totals,
-counters, exact histogram stats (count/sum/min/max), and a BOUNDED
-histogram sample — once a series reaches ``_HIST_CAP`` values it is
-decimated 2:1 and subsequent observations are systematically subsampled
-(deterministic, no RNG), so percentiles stay representative while memory
-stays flat.  Finished spans are retained only by attached sinks
-(``blance_tpu.obs.sinks``); an un-sinked recorder in a long-running
-service never grows with traffic.
+counters, gauges, exact histogram stats (count/sum/min/max) and bucket
+counts, and a BOUNDED percentile sample — once a series reaches
+``_HIST_CAP`` values it is decimated 2:1 and subsequent observations are
+systematically subsampled (deterministic, no RNG), so percentiles stay
+representative while memory stays flat.  Finished spans are retained
+only by attached sinks (``blance_tpu.obs.sinks``); an un-sinked recorder
+in a long-running service never grows with traffic.
 
-Timestamps are ``time.perf_counter()`` seconds, offset against the
-recorder's construction time (``t0``) at export — one consistent
-monotonic clock for every span in a process, which is what lets the
-Chrome-trace exporter lay host spans on a single timeline next to
-``device_profile`` TPU traces captured over the same interval.
+Timestamps come from the recorder's injectable ``clock`` (default
+``time.perf_counter``) in seconds, offset against the recorder's
+construction time (``t0``) at export — one consistent monotonic clock
+for every span in a process, which is what lets the Chrome-trace
+exporter lay host spans on a single timeline next to ``device_profile``
+TPU traces captured over the same interval.  Injecting the clock is
+what makes telemetry DETERMINISTIC under the controlled virtual-time
+loop (``testing.sched.DeterministicLoop``): ``Recorder(clock=loop.time)``
+makes every span duration, SLO gauge, and exposition snapshot a pure
+function of the (seeded) schedule.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import contextvars
 import itertools
@@ -41,7 +52,7 @@ import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Iterator, Optional
+from typing import TYPE_CHECKING, Callable, Iterator, Optional
 
 if TYPE_CHECKING:  # annotation-only
     from ..utils.trace import PhaseTimer
@@ -50,6 +61,7 @@ if TYPE_CHECKING:  # annotation-only
 __all__ = [
     "Span",
     "Recorder",
+    "DEFAULT_BUCKETS",
     "get_recorder",
     "set_recorder",
     "use_recorder",
@@ -99,6 +111,19 @@ def percentile(values: list, q: float) -> float:
 # the sample stays spread evenly over the series' whole history.
 _HIST_CAP = 4096
 
+# Default histogram bucket upper bounds (``le`` semantics), log-spaced
+# 1-2.5-5 per decade from 100 µs to 10k.  Wide on purpose: one fixed set
+# covers sub-ms move latencies, solver sweep counts, and candidate-list
+# sizes, so EVERY series has exact Prometheus-style bucket counts from
+# its first observation without per-name registration (a +Inf bucket is
+# implicit).  Override per series with ``Recorder.set_hist_bounds``
+# BEFORE the first observation.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
 
 def _current_task_label() -> str:
     """Lane label: the asyncio task name when inside one, else the thread."""
@@ -114,38 +139,65 @@ def _current_task_label() -> str:
 
 
 class Recorder:
-    """Span/counter/histogram recorder with pluggable sinks.
+    """Span/counter/gauge/histogram recorder with pluggable sinks.
 
     Thread-safe for aggregate updates (one lock); span parenthood is
     context-local, never locked.  ``sinks`` receive every finished span
-    via their ``span(span)`` method."""
+    via their ``span(span)`` method; a sink that also defines
+    ``counter(name, value, t)`` additionally sees every counter update
+    live (the Chrome exporter uses this for time-series counter tracks).
 
-    def __init__(self, sinks: tuple = ()) -> None:
-        self.t0 = time.perf_counter()
+    ``clock`` is the recorder's one time source (monotonic seconds);
+    inject ``DeterministicLoop.time`` to run all telemetry — span
+    durations, SLO gauges, exposition snapshots — under virtual time."""
+
+    def __init__(self, sinks: tuple = (),
+                 clock: Callable[[], float] = time.perf_counter) -> None:
+        self._clock = clock
+        self.t0 = clock()
         self.sinks: list = list(sinks)
         self.span_totals: dict[str, float] = {}
         self.span_counts: dict[str, int] = {}
         self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
         self.histograms: dict[str, list[float]] = {}  # bounded sample
         self._hist_stats: dict[str, list] = {}  # [count, sum, min, max]
         self._hist_stride: dict[str, int] = {}
+        self._hist_bounds: dict[str, tuple[float, ...]] = {}
+        self._hist_buckets: dict[str, list[int]] = {}  # per-bound counts
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
+        # Sinks that opted into live counter samples, cached at
+        # add/remove time so count() — the orchestrator's hottest obs
+        # call — never probes hasattr under the lock.
+        self._counter_sinks: list = [
+            s for s in self.sinks if hasattr(s, "counter")]
         # Per-instance ContextVar: two recorders never share nesting state
         # (tests swap recorders mid-process via use_recorder).
         self._current: contextvars.ContextVar[Optional[Span]] = \
             contextvars.ContextVar(f"obs_span_{id(self)}", default=None)
+
+    def now(self) -> float:
+        """The recorder's clock — the one time source every instrumented
+        layer should read instead of ``time.perf_counter`` directly, so
+        a virtual-time clock injection covers the whole pipeline."""
+        return self._clock()
 
     # -- spans ---------------------------------------------------------------
 
     def add_sink(self, sink: "Sink") -> None:
         with self._lock:
             self.sinks.append(sink)
+            if hasattr(sink, "counter"):
+                self._counter_sinks = self._counter_sinks + [sink]
 
     def remove_sink(self, sink: "Sink") -> None:
         with self._lock:
             if sink in self.sinks:
                 self.sinks.remove(sink)
+            if sink in self._counter_sinks:
+                self._counter_sinks = [
+                    s for s in self._counter_sinks if s is not sink]
 
     def current_span(self) -> Optional[Span]:
         return self._current.get()
@@ -158,7 +210,7 @@ class Recorder:
         parent = self._current.get()
         sp = Span(
             name=name,
-            t_start=time.perf_counter() if t_start is None else t_start,
+            t_start=self._clock() if t_start is None else t_start,
             t_end=None,
             attrs=dict(attrs),
             span_id=next(self._ids),
@@ -171,7 +223,7 @@ class Recorder:
             yield sp
         finally:
             self._current.reset(token)
-            sp.t_end = time.perf_counter()
+            sp.t_end = self._clock()
             self._finish(sp)
 
     def record_span(self, name: str, t_start: float, t_end: float, *,
@@ -204,11 +256,36 @@ class Recorder:
         for sink in sinks:
             sink.span(sp)
 
-    # -- counters / histograms ----------------------------------------------
+    # -- counters / gauges / histograms --------------------------------------
 
     def count(self, name: str, value: float = 1) -> None:
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + value
+            total = self.counters[name] = self.counters.get(name, 0) + value
+            # Cached at add/remove-sink time; rebound wholesale there, so
+            # grabbing the reference is safe and the common no-hook path
+            # stays one dict update under the lock.
+            notify = self._counter_sinks
+        if notify:
+            t = self._clock()
+            for sink in notify:
+                sink.counter(name, total, t)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Set a last-value-wins gauge (SLO accounting publishes here;
+        the exposition endpoint serves them)."""
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def set_hist_bounds(self, name: str, bounds: tuple[float, ...]) -> None:
+        """Override the bucket upper bounds for one series.  Must happen
+        before the series' first observation — bucket counts are exact
+        by construction and cannot be re-binned after the fact."""
+        with self._lock:
+            if name in self._hist_stats:
+                raise ValueError(
+                    f"histogram {name!r} already has observations; bucket "
+                    f"bounds must be set before the first observe()")
+            self._hist_bounds[name] = tuple(sorted(float(b) for b in bounds))
 
     def observe(self, name: str, value: float) -> None:
         v = float(value)
@@ -222,6 +299,13 @@ class Recorder:
                 st[2] = v
             if v > st[3]:
                 st[3] = v
+            # Exact per-bound bucket counts (le semantics; the final slot
+            # is the +Inf bucket).  Incremental here, cumulated at export.
+            bounds = self._hist_bounds.get(name, DEFAULT_BUCKETS)
+            buckets = self._hist_buckets.get(name)
+            if buckets is None:
+                buckets = self._hist_buckets[name] = [0] * (len(bounds) + 1)
+            buckets[bisect.bisect_left(bounds, v)] += 1
             # Bounded percentile sample: systematic 1-in-stride subsample,
             # stride doubling on each 2:1 decimation at the cap.
             stride = self._hist_stride.get(name, 1)
@@ -233,6 +317,26 @@ class Recorder:
                     self._hist_stride[name] = stride * 2
 
     # -- summaries -----------------------------------------------------------
+
+    def histogram_buckets(
+            self, name: str) -> Optional[tuple[tuple[float, ...],
+                                               list[int], int, float]]:
+        """(bounds, cumulative counts incl. +Inf, count, sum) for one
+        series, or None if never observed.  Counts are EXACT (every
+        observation lands in exactly one bucket), so the exposition's
+        ``_bucket``/``_count``/``_sum`` agree by construction."""
+        with self._lock:
+            buckets = self._hist_buckets.get(name)
+            if buckets is None:
+                return None
+            st = self._hist_stats[name]
+            bounds = self._hist_bounds.get(name, DEFAULT_BUCKETS)
+            cum: list[int] = []
+            running = 0
+            for c in buckets:
+                running += c
+                cum.append(running)
+            return bounds, cum, st[0], st[1]
 
     def histogram_summary(self, name: str) -> Optional[dict]:
         with self._lock:
@@ -251,8 +355,8 @@ class Recorder:
 
     def summary(self) -> dict:
         """Everything aggregate, JSON-serializable: per-span-name totals,
-        counters, and histogram percentile summaries — the block bench.py
-        embeds into its artifact."""
+        counters, gauges, and histogram percentile summaries — the block
+        bench.py embeds into its artifact."""
         with self._lock:
             spans = {
                 name: {"total_s": self.span_totals[name],
@@ -260,10 +364,12 @@ class Recorder:
                 for name in sorted(self.span_totals)
             }
             counters = {k: self.counters[k] for k in sorted(self.counters)}
+            gauges = {k: self.gauges[k] for k in sorted(self.gauges)}
             hist_names = sorted(self.histograms)
         return {
             "spans": spans,
             "counters": counters,
+            "gauges": gauges,
             "histograms": {
                 name: self.histogram_summary(name) for name in hist_names
             },
@@ -309,11 +415,11 @@ def phase_span(name: str, timer: Optional["PhaseTimer"] = None,
     default: the last dot segment) — one timed region, two views, no
     double-recorded span."""
     rec = get_recorder()
-    start = time.perf_counter()
+    start = rec.now()
     try:
         with rec.span(name, **attrs) as sp:
             yield sp
     finally:
         if timer is not None:
             timer._accumulate(phase or name.rsplit(".", 1)[-1],
-                              time.perf_counter() - start)
+                              rec.now() - start)

@@ -1,0 +1,257 @@
+"""Online SLO accounting for a live rebalance.
+
+The continuous-rebalance story (ROADMAP item 4) needs service-level
+numbers DURING the transition, not after: how much of the keyspace is
+serving right now, how much movement the convergence is costing, and
+whether progress has stalled.  :class:`SloTracker` computes them online:
+
+- **partition availability** — the fraction of partitions with at least
+  one node in a serving-primary state.  Maintained INCREMENTALLY: the
+  tracker holds a per-partition ``node -> state`` view seeded from the
+  begin map and applies each successfully executed move as the
+  orchestrator reports it (the achieved-map delta), so an update is
+  O(moves in the batch), never a full-map recompute.
+- **cumulative churn** — successfully executed moves divided by the
+  minimum necessary (the primary plan's move count).  1.0 is a perfect
+  run; retries burned on abandoned partitions and recovery-round
+  re-placements push it above 1.
+- **convergence lag** — seconds (on the tracker's clock, so virtual
+  seconds under ``DeterministicLoop``) since the last successfully
+  executed move: the "is it stuck" gauge.
+- **per-node quarantine exposure** — cumulative seconds each node has
+  spent quarantined/half-open, read from the orchestrator's
+  ``HealthTracker``.
+
+The tracker is an orchestrator *move observer* (``on_batch``): the
+mover calls it after every batch with the outcome.  Updates are plain
+sync methods with no awaits — on the event loop they are atomic, so
+concurrent movers cannot tear the placement view (the race lint's
+``SHARED_STATE`` table declares the attributes; the schedule explorer's
+``slo_gauges_under_chaos`` scenario checks the bounds dynamically).
+
+Gauges are published to a Recorder (``slo.*`` — see the
+``MetricsRegistry`` table in ``obs/expo.py``) on every update;
+``publish`` is also the collector hook a ``MetricsServer`` calls before
+each snapshot so time-derived gauges stay fresh between events.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, \
+    Sequence
+
+from .recorder import Recorder, get_recorder
+
+__all__ = ["MoveObserver", "SloSummary", "SloTracker"]
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, double quote,
+    newline): node names are arbitrary caller strings, and one bad
+    character must not invalidate the whole scrape."""
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+class MoveObserver(Protocol):
+    """What the orchestrator notifies after every batch outcome.  A
+    'move' is duck-typed (``partition``/``node``/``state``/``op``
+    attributes) so observers need no import of the orchestrate layer."""
+
+    def on_batch(self, node: str, moves: Sequence[Any], ok: bool,
+                 now: float) -> None: ...
+
+
+@dataclass
+class SloSummary:
+    """The end-of-run SLO snapshot (``RebalanceResult.slo``, the bench
+    artifact's ``slo`` block).  Formulas in docs/OBSERVABILITY.md."""
+
+    availability: float
+    churn_ratio: float
+    convergence_lag_s: float
+    moves_executed: int
+    moves_failed: int
+    min_moves: int
+    partitions: int
+    available_partitions: int
+    quarantine_exposure_s: dict[str, float] = field(default_factory=dict)
+
+
+class SloTracker:
+    """Incremental SLO gauges over one (possibly multi-round) rebalance.
+
+    ``beg_map`` seeds the placement view; ``primary_states`` names the
+    states that count as "serving" (the priority-0 states of the model;
+    ``rebalance_async`` computes this automatically).  ``clock`` is the
+    time source for convergence lag — pass ``recorder.now`` so SLO time
+    and span time agree (and both follow a virtual clock in tests)."""
+
+    def __init__(self, beg_map: Mapping[str, Any],
+                 primary_states: Iterable[str] = ("primary",),
+                 clock: Optional[Callable[[], float]] = None,
+                 recorder: Optional[Recorder] = None) -> None:
+        self._rec = recorder
+        self._clock: Callable[[], float] = (
+            clock if clock is not None
+            else (recorder.now if recorder is not None else time.perf_counter))
+        self._primary_states = frozenset(primary_states)
+        # partition -> {node -> state}: the live placement view.
+        self._placements: dict[str, dict[str, str]] = {}
+        # partition -> number of serving-primary holders.
+        self._primaries: dict[str, int] = {}
+        self._available = 0
+        for name, part in beg_map.items():
+            d: dict[str, str] = {}
+            for state, ns in part.nodes_by_state.items():
+                for n in ns:
+                    d[n] = state
+            self._placements[name] = d
+            prim = sum(1 for s in d.values() if s in self._primary_states)
+            self._primaries[name] = prim
+            if prim > 0:
+                self._available += 1
+        self._total = len(self._placements)
+        self._min_moves = 0
+        self.moves_executed = 0
+        self.moves_failed = 0
+        self._t_last_progress = self._clock()
+        self._health: Optional[Any] = None
+
+    # -- wiring ---------------------------------------------------------------
+
+    def set_min_moves(self, n: int) -> None:
+        """Pin the churn denominator to the PRIMARY plan's move count.
+        First call wins: recovery rounds re-plan, but the minimum
+        necessary is what the original transition needed."""
+        if self._min_moves == 0:
+            self._min_moves = max(int(n), 0)
+
+    def attach_health(self, health: Optional[Any]) -> None:
+        """Adopt the orchestrator's HealthTracker (it carries across
+        recovery rounds) as the quarantine-exposure source."""
+        if health is not None:
+            self._health = health
+
+    # -- the orchestrator hook ------------------------------------------------
+
+    def on_batch(self, node: str, moves: Sequence[Any], ok: bool,
+                 now: float) -> None:
+        """One batch outcome from a mover.  ``ok`` means the assign
+        callback succeeded and the moves are applied cluster-side; a
+        failed batch is assumed NOT applied (the orchestrator's
+        achieved-map presumption) and only counts against churn
+        bookkeeping as failures."""
+        if ok:
+            for mv in moves:
+                self._apply(mv)
+            self.moves_executed += len(moves)
+            self._t_last_progress = now
+        else:
+            self.moves_failed += len(moves)
+        self.publish(now)
+
+    def _apply(self, mv: Any) -> None:
+        """One executed move against the placement view: remove the node
+        from wherever it was, then (unless the move is a removal) place
+        it in the move's state — mirroring ``Orchestrator.achieved_map``
+        one move at a time."""
+        d = self._placements.get(mv.partition)
+        if d is None:  # a partition outside the begin map: ignore
+            return
+        was_available = self._primaries[mv.partition] > 0
+        old = d.pop(mv.node, None)
+        if old in self._primary_states:
+            self._primaries[mv.partition] -= 1
+        if mv.state:
+            d[mv.node] = mv.state
+            if mv.state in self._primary_states:
+                self._primaries[mv.partition] += 1
+        now_available = self._primaries[mv.partition] > 0
+        if was_available != now_available:
+            self._available += 1 if now_available else -1
+
+    def strip_nodes(self, nodes: Iterable[str]) -> None:
+        """Drop every placement on ``nodes`` — the recovery-round
+        presumption that a quarantined node's data is lost.  Mirrors
+        ``rebalance._strip_nodes`` on the incremental view."""
+        dead = set(nodes)
+        if not dead:
+            return
+        for name, d in self._placements.items():
+            was_available = self._primaries[name] > 0
+            for n in list(d):
+                if n in dead:
+                    if d.pop(n) in self._primary_states:
+                        self._primaries[name] -= 1
+            now_available = self._primaries[name] > 0
+            if was_available != now_available:
+                self._available += 1 if now_available else -1
+        self.publish()
+
+    # -- gauges ---------------------------------------------------------------
+
+    def availability(self) -> float:
+        """available partitions / total partitions, in [0, 1]."""
+        return self._available / self._total if self._total else 1.0
+
+    def churn_ratio(self) -> float:
+        """moves executed / minimum necessary (>= 0; 0 until a plan is
+        pinned, 1.0 for a perfect single-pass run)."""
+        return self.moves_executed / self._min_moves if self._min_moves \
+            else 0.0
+
+    def convergence_lag_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last forward progress (executed move)."""
+        t = self._clock() if now is None else now
+        return max(t - self._t_last_progress, 0.0)
+
+    def quarantine_exposure_s(self) -> dict[str, float]:
+        """node -> cumulative quarantined seconds, from the attached
+        HealthTracker (empty when no breaker is wired).  The tracker
+        reads its OWN clock for the open interval — its ``tripped_at``
+        stamps came from that clock, and mixing another clock's 'now'
+        into the subtraction would corrupt the arithmetic (perf_counter
+        and monotonic have unrelated epochs)."""
+        if self._health is None:
+            return {}
+        out: dict[str, float] = self._health.exposures()
+        return out
+
+    # -- exposition -----------------------------------------------------------
+
+    def publish(self, now: Optional[float] = None) -> None:
+        """Write every gauge into the recorder (``slo.*``).  Collector-
+        compatible: a MetricsServer calls this before each snapshot."""
+        rec = self._rec if self._rec is not None else get_recorder()
+        t = self._clock() if now is None else now
+        rec.set_gauge("slo.partition_availability", self.availability())
+        rec.set_gauge("slo.churn_ratio", self.churn_ratio())
+        rec.set_gauge("slo.convergence_lag_s", self.convergence_lag_s(t))
+        rec.set_gauge("slo.moves_executed", self.moves_executed)
+        rec.set_gauge("slo.moves_failed", self.moves_failed)
+        rec.set_gauge("slo.min_moves", self._min_moves)
+        exposures = self.quarantine_exposure_s()
+        rec.set_gauge("slo.quarantined_nodes", float(len(
+            self._health.quarantined_nodes()) if self._health is not None
+            else 0))
+        for node, exposure in exposures.items():
+            rec.set_gauge(
+                f'slo.quarantine_exposure_s{{node="{_escape_label(node)}"}}',
+                exposure)
+
+    def summary(self, now: Optional[float] = None) -> SloSummary:
+        t = self._clock() if now is None else now
+        return SloSummary(
+            availability=self.availability(),
+            churn_ratio=self.churn_ratio(),
+            convergence_lag_s=self.convergence_lag_s(t),
+            moves_executed=self.moves_executed,
+            moves_failed=self.moves_failed,
+            min_moves=self._min_moves,
+            partitions=self._total,
+            available_partitions=self._available,
+            quarantine_exposure_s=self.quarantine_exposure_s(),
+        )

@@ -51,6 +51,11 @@ class NodeHealth:
     trips: int = 0  # lifetime quarantine entries
     tripped_at: float = 0.0  # clock() of the last trip
     probe_in_flight: bool = False
+    # Cumulative seconds spent quarantined/half-open across CLOSED
+    # quarantine intervals; the currently-open interval (tripped_at ->
+    # now) is added at read time (HealthTracker.exposure_s) — the SLO
+    # plane's per-node quarantine-exposure gauge.
+    exposure_s: float = 0.0
 
 
 @dataclass
@@ -79,6 +84,9 @@ class HealthTracker:
         """A callback attempt for ``node`` succeeded: half-open heals,
         failure streaks reset."""
         h = self._get(node)
+        if h.state in (QUARANTINED, HALF_OPEN):
+            # Close the open quarantine interval into the exposure total.
+            h.exposure_s += max(self.clock() - h.tripped_at, 0.0)
         h.consecutive_failures = 0
         h.probe_in_flight = False
         h.state = HEALTHY
@@ -97,6 +105,10 @@ class HealthTracker:
             tripped = h.state == HEALTHY and \
                 h.consecutive_failures >= max(self.threshold, 1)
         if tripped:
+            if was_open:
+                # Half-open re-trip: the dwell so far closes into the
+                # exposure total before the interval clock restarts.
+                h.exposure_s += max(self.clock() - h.tripped_at, 0.0)
             h.state = QUARANTINED
             h.tripped_at = self.clock()
             h.trips += 1
@@ -139,3 +151,24 @@ class HealthTracker:
 
     def total_trips(self) -> int:
         return sum(h.trips for h in self._nodes.values())
+
+    def exposure_s(self, node: str, now: Optional[float] = None) -> float:
+        """Cumulative quarantined/half-open seconds for ``node``: every
+        closed interval plus the currently-open one (if tripped)."""
+        h = self._nodes.get(node)
+        if h is None:
+            return 0.0
+        total = h.exposure_s
+        if h.state in (QUARANTINED, HALF_OPEN):
+            t = self.clock() if now is None else now
+            total += max(t - h.tripped_at, 0.0)
+        return total
+
+    def exposures(self, now: Optional[float] = None) -> dict[str, float]:
+        """node -> cumulative exposure seconds, for every node that has
+        ever been quarantined (the SLO per-node exposure gauge)."""
+        out: dict[str, float] = {}
+        for node, h in self._nodes.items():
+            if h.trips > 0:
+                out[node] = self.exposure_s(node, now)
+        return out

@@ -23,10 +23,9 @@ from __future__ import annotations
 import asyncio
 import inspect
 import random
-import time
 import warnings as _warnings
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional, Union
+from typing import TYPE_CHECKING, Awaitable, Callable, Optional, Union
 
 from ..core.types import Partition, PartitionMap, PartitionModel
 from ..moves.calc import calc_partition_moves
@@ -34,6 +33,9 @@ from ..obs import get_recorder
 from ..plan.greedy import sort_state_names
 from .csp import Chan, select, GET, PUT
 from .health import HealthTracker
+
+if TYPE_CHECKING:  # annotation-only; obs.slo must not import us back
+    from ..obs.slo import MoveObserver
 
 __all__ = [
     "ErrorStopped",
@@ -274,16 +276,18 @@ class NextMoves:
 class _PartitionMoveReq:
     """A batch of moves for one node + completion channel (orchestrate.go:220-223).
 
-    ``t_created`` stamps the feeder's creation time so the mover that
+    ``t_created`` stamps the feeder's creation time (on the Recorder's
+    clock, so virtual time under DeterministicLoop) so the mover that
     eventually dequeues the batch can attribute queue/concurrency wait
     separately from callback execution (the ``orchestrate.move`` span)."""
 
     __slots__ = ("partition_moves", "done_ch", "t_created")
 
-    def __init__(self, partition_moves: list[PartitionMove], done_ch: Chan) -> None:
+    def __init__(self, partition_moves: list[PartitionMove], done_ch: Chan,
+                 t_created: float) -> None:
         self.partition_moves = partition_moves
         self.done_ch = done_ch
-        self.t_created = time.perf_counter()
+        self.t_created = t_created
 
 
 AssignPartitionsFunc = Callable[..., Union[Optional[Exception], Awaitable]]
@@ -303,6 +307,7 @@ class Orchestrator:
         assign_partitions: AssignPartitionsFunc,
         find_move: Optional[FindMoveFunc],
         map_partition_to_next_moves: dict[str, NextMoves],
+        move_observers: "tuple[MoveObserver, ...]" = (),
     ) -> None:
         self.model = model
         self.options = options
@@ -331,8 +336,16 @@ class Orchestrator:
         # (orchestrate.tot_*) as it increments, so one sink sees the
         # progress stream, the planner spans, and the move lifecycle
         # together.  Bound once: a rebalance reports to the recorder that
-        # was installed when it started.
+        # was installed when it started.  The recorder's clock is also
+        # the orchestrator's ONLY time source (queue waits, exec
+        # timings), so an injected virtual clock covers the whole move
+        # lifecycle deterministically.
         self._rec = get_recorder()
+        # Move observers (obs.slo.MoveObserver): notified synchronously
+        # after every batch outcome with (node, moves, ok, now) — the
+        # SLO plane's incremental achieved-map delta feed.  Immutable
+        # after init; callbacks must be plain sync code.
+        self._observers: "tuple[MoveObserver, ...]" = tuple(move_observers)
 
         # -- fault tolerance (all inert when options keep the defaults) --
         self._ft = options.fault_tolerant
@@ -340,9 +353,15 @@ class Orchestrator:
         if options.health is not None:
             self.health: Optional[HealthTracker] = options.health
         elif options.quarantine_after > 0:
+            # The breaker shares the recorder's clock so quarantine
+            # dwell/exposure accounting and the SLO gauges agree (and
+            # all follow virtual time when a test injects one);
+            # perf_counter and monotonic have unrelated epochs, so
+            # mixing them would corrupt exposure arithmetic.
             self.health = HealthTracker(
                 threshold=options.quarantine_after,
-                probe_after_s=options.probe_after_s)
+                probe_after_s=options.probe_after_s,
+                clock=self._rec.now)
         else:
             self.health = None
         self._retry_rng = random.Random(options.retry_seed)
@@ -599,7 +618,7 @@ class Orchestrator:
             req, ok = value
             if not ok:
                 return None
-            t_recv = time.perf_counter()
+            t_recv = self._rec.now()
 
             partitions = [pm.partition for pm in req.partition_moves]
             states = [pm.state for pm in req.partition_moves]
@@ -630,12 +649,12 @@ class Orchestrator:
                 else:
                     await self._bump("tot_mover_assign_partition")
 
-                    t_exec = time.perf_counter()
+                    t_exec = self._rec.now()
                     with self._rec.span("orchestrate.move.exec", task=lane,
                                         node=node, ops=",".join(ops)):
                         err, attempts = await self._exec_with_retries(
                             stop_ch, node, partitions, states, ops)
-                    exec_s = time.perf_counter() - t_exec
+                    exec_s = self._rec.now() - t_exec
                     mv.attrs["wait_s"] = t_recv - req.t_created
                     mv.attrs["exec_s"] = exec_s
                     mv.attrs["ok"] = err is None
@@ -653,6 +672,16 @@ class Orchestrator:
                     await self._bump(
                         "tot_mover_assign_partition_err" if err is not None
                         else "tot_mover_assign_partition_ok")
+
+            # SLO / cost-model hook: every batch outcome, success or
+            # failure, with the recorder-clock timestamp.  Observers are
+            # sync (no await): the placement-view update is atomic on
+            # the loop, so concurrent movers cannot tear it.
+            if self._observers:
+                t_done = self._rec.now()
+                for observer in self._observers:
+                    observer.on_batch(node, req.partition_moves,
+                                      err is None, t_done)
 
             if err is not None and self._ft:
                 # Structured failure per partition move in the batch; the
@@ -898,6 +927,7 @@ class Orchestrator:
                     for nm in next_moves
                 ],
                 done_ch=next_done_ch,
+                t_created=self._rec.now(),
             )
 
             # A move can target a node with no mover (not in nodes_all).  The
@@ -914,6 +944,11 @@ class Orchestrator:
                 if self._ft and self.options.move_timeout_s is not None:
                     first = await self._record_batch_failure(
                         node, req.partition_moves, 0, MissingMoverError(node))
+                    if self._observers:
+                        t_done = self._rec.now()
+                        for observer in self._observers:
+                            observer.on_batch(node, req.partition_moves,
+                                              False, t_done)
                     for nm in next_moves:
                         nm.failed_at = nm.next
                         nm.next = len(nm.moves)
@@ -997,6 +1032,7 @@ def orchestrate_moves(
     end_map: PartitionMap,
     assign_partitions: AssignPartitionsFunc,
     find_move: Optional[FindMoveFunc] = None,
+    move_observers: "tuple[MoveObserver, ...]" = (),
 ) -> Orchestrator:
     """Asynchronously begin reassigning partitions from beg_map to end_map
     (orchestrate.go:240-338).  Must be called with a running asyncio loop;
@@ -1008,6 +1044,10 @@ def orchestrate_moves(
 
     find_move(node, moves) -> index picks each node's next move; defaults to
     lowest_weight_partition_move_for_node.
+
+    move_observers: zero or more ``obs.slo.MoveObserver``s, notified
+    synchronously after every batch outcome — the live-telemetry hook
+    (SLO accounting) that sees each achieved-map delta as it lands.
     """
     if len(beg_map) != len(end_map):
         raise ValueError("mismatched begMap and endMap")
@@ -1047,6 +1087,7 @@ def orchestrate_moves(
     o = Orchestrator(
         model, options, nodes_all, beg_map, end_map,
         assign_partitions, find_move, map_partition_to_next_moves,
+        move_observers=move_observers,
     )
     o._start(o._stop_ch)
     return o

@@ -41,6 +41,7 @@ from .core.types import (
     partition_map_to_json,
 )
 from .obs import get_recorder
+from .obs.slo import SloSummary, SloTracker
 from .orchestrate.orchestrator import (
     FindMoveFunc,
     MoveFailure,
@@ -92,6 +93,11 @@ class RebalanceResult:
     # a clean run); populated only when fault tolerance is on.
     achieved_map: Optional[PartitionMap] = None
     quarantined_nodes: list[str] = field(default_factory=list)
+    # End-of-run SLO snapshot (obs/slo.py): availability, churn,
+    # convergence lag, per-node quarantine exposure.  The live gauges
+    # stream on the exposition endpoint during the run; this is the
+    # final reading.
+    slo: Optional[SloSummary] = None
 
 
 def save_partition_map(pmap: PartitionMap, path: str) -> None:
@@ -181,6 +187,7 @@ async def rebalance_async(
     checkpoint_path: Optional[str] = None,
     max_recovery_rounds: int = 0,
     session=None,
+    slo: Optional[SloTracker] = None,
 ) -> RebalanceResult:
     """Plan the next map and execute the transition against the callback.
 
@@ -199,9 +206,24 @@ async def rebalance_async(
     plan.session.PlannerSession covering the same partitions/nodes, makes
     the planning incremental: recovery replans warm-start off the solver
     carry when the failures were confined to the dead nodes.
+
+    slo: an ``obs.slo.SloTracker`` to account availability/churn/lag
+    against (pass your own when you also feed it to a ``MetricsServer``
+    so the gauges stream live); one is created internally otherwise.
+    Either way the tracker rides the orchestrator as a move observer,
+    publishes ``slo.*`` gauges to the process recorder as the run
+    progresses, and its final reading lands in ``RebalanceResult.slo``.
     """
     timer = PhaseTimer()
     rec = get_recorder()
+    if slo is None:
+        # "Serving" = the model's highest-priority (priority-0) states.
+        top = min((st.priority for st in model.values()), default=0)
+        slo = SloTracker(
+            current_map,
+            primary_states=[s for s, st in model.items()
+                            if st.priority == top],
+            clock=rec.now, recorder=rec)
     opts = orchestrator_options or OrchestratorOptions()
     ft = opts.fault_tolerant
     if max_recovery_rounds > 0 and not ft:
@@ -283,7 +305,15 @@ async def rebalance_async(
                 next_map,
                 assign_partitions,
                 find_move or lowest_weight_partition_move_for_node,
+                move_observers=(slo,),
             )
+            if round_i == 0:
+                # The churn denominator: the PRIMARY plan's move count
+                # is the minimum a perfect run would execute; recovery
+                # rounds only ever add to the numerator.
+                o.visit_next_moves(lambda m: slo.set_min_moves(
+                    sum(len(nm.moves) for nm in m.values())))
+            slo.attach_health(o.health)
             async for progress in o.progress_ch():
                 events += 1
                 final = progress
@@ -303,6 +333,10 @@ async def rebalance_async(
             progress=final))
         if ft:
             achieved = _strip_nodes(o.achieved_map(), set(quarantined))
+            # Mirror the presumption on the live SLO view: a quarantined
+            # node's placements are lost, so availability drops NOW, not
+            # after the recovery round re-places them.
+            slo.strip_nodes(set(quarantined))
 
         if not ft or not round_failures:
             # Converged (or legacy mode, which never recovers): a
@@ -338,6 +372,7 @@ async def rebalance_async(
         removes = sorted(set(removes) | set(quarantined))
         adds = []
 
+    slo.publish()
     return RebalanceResult(
         next_map=next_map,
         warnings=all_warnings,
@@ -348,6 +383,7 @@ async def rebalance_async(
         rounds=rounds,
         achieved_map=achieved,
         quarantined_nodes=list(quarantined),
+        slo=slo.summary(),
     )
 
 

@@ -585,6 +585,8 @@ def test_race_lint_ignores_unmodeled_classes():
 def test_race_lint_real_package_model_matches_reality():
     """The shared-state table must keep naming real attributes of the
     real classes — a renamed attribute would silently blind the lint."""
+    import blance_tpu.obs.costmodel as costmodel
+    import blance_tpu.obs.slo as slo
     import blance_tpu.orchestrate.csp as csp
     import blance_tpu.orchestrate.health as health
     import blance_tpu.orchestrate.orchestrator as orch
@@ -600,6 +602,8 @@ def test_race_lint_real_package_model_matches_reality():
         "NodeHealth": inspect.getsource(health.NodeHealth),
         "Chan": inspect.getsource(csp.Chan),
         "NextMoves": inspect.getsource(orch.NextMoves),
+        "SloTracker": inspect.getsource(slo.SloTracker),
+        "CostModel": inspect.getsource(costmodel.CostModel),
     }
     for cls, attrs in SHARED_STATE.items():
         src = sources[cls]

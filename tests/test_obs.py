@@ -474,3 +474,173 @@ def test_recorder_without_sink_retains_no_spans():
     assert rec.span_counts["s"] == 1
     assert not hasattr(rec, "spans")
     assert rec.sinks == []
+
+
+# ---------------------------------------------------------------------------
+# Injectable clock, gauges, exact histogram buckets (the live-telemetry
+# plane's Recorder extensions)
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_injectable_clock_drives_all_timestamps():
+    """Every timestamp — t0, span endpoints, now() — comes from the
+    injected clock, so virtual-time tests control telemetry time."""
+    t = [100.0]
+    rec = Recorder(clock=lambda: t[0])
+    assert rec.t0 == 100.0
+    assert rec.now() == 100.0
+    with rec.span("s") as sp:
+        t[0] = 101.5
+    assert sp.t_start == 100.0 and sp.t_end == 101.5
+    assert sp.duration_s == pytest.approx(1.5)
+    assert rec.span_totals["s"] == pytest.approx(1.5)
+    t[0] = 103.25
+    assert rec.now() == 103.25
+
+
+def test_recorder_gauges_last_value_wins_and_summary():
+    rec = Recorder()
+    rec.set_gauge("slo.partition_availability", 0.5)
+    rec.set_gauge("slo.partition_availability", 0.75)
+    rec.set_gauge('slo.quarantine_exposure_s{node="n1"}', 2.5)
+    assert rec.gauges["slo.partition_availability"] == 0.75
+    s = rec.summary()
+    assert s["gauges"]["slo.partition_availability"] == 0.75
+    assert s["gauges"]['slo.quarantine_exposure_s{node="n1"}'] == 2.5
+
+
+def test_histogram_bucket_counts_exact_le_semantics():
+    """Bucket counts are exact with `le` (<=) boundary semantics: a
+    value equal to a bound lands in that bound's bucket; the implicit
+    final slot is +Inf."""
+    rec = Recorder()
+    rec.set_hist_bounds("lat", (0.01, 0.1, 1.0))
+    for v in (0.01, 0.05, 0.5, 5.0):
+        rec.observe("lat", v)
+    bounds, cum, count, total = rec.histogram_buckets("lat")
+    assert bounds == (0.01, 0.1, 1.0)
+    assert cum == [1, 2, 3, 4]  # cumulative; 0.01 counted at le=0.01
+    assert count == 4
+    assert total == pytest.approx(5.56)
+    # Re-binning after data exists is refused (counts are exact, not
+    # reconstructible).
+    with pytest.raises(ValueError, match="before the first observe"):
+        rec.set_hist_bounds("lat", (1.0,))
+    assert rec.histogram_buckets("never") is None
+
+
+def test_histogram_default_buckets_cover_outliers():
+    from blance_tpu.obs.recorder import DEFAULT_BUCKETS
+
+    rec = Recorder()
+    rec.observe("big", 1e9)  # beyond every bound: +Inf bucket only
+    bounds, cum, count, total = rec.histogram_buckets("big")
+    assert bounds == DEFAULT_BUCKETS
+    assert cum[-2] == 0 and cum[-1] == 1 and count == 1
+
+
+def test_histogram_buckets_consistent_with_exact_stats_at_scale():
+    """Bucket count stays exact (== stats count) even past the
+    percentile sample's decimation cap."""
+    from blance_tpu.obs.recorder import _HIST_CAP
+
+    rec = Recorder()
+    n = _HIST_CAP * 3
+    for v in range(n):
+        rec.observe("lat", v / 1000.0)
+    _bounds, cum, count, _total = rec.histogram_buckets("lat")
+    assert count == n == cum[-1]
+    assert len(rec.histograms["lat"]) <= _HIST_CAP
+
+
+# ---------------------------------------------------------------------------
+# JsonlSink rotation (size-capped, keep-N)
+# ---------------------------------------------------------------------------
+
+
+def _spin_spans(rec, n, name="s"):
+    for _ in range(n):
+        with rec.span(name, pad="x" * 64):
+            pass
+
+
+def test_jsonl_sink_rotation_boundary(tmp_path):
+    """Crossing max_bytes rotates AFTER the triggering line: no record
+    is ever split across files, every file is valid JSONL, the cap is
+    overshot by at most one record, and only `keep` rotated files
+    survive."""
+    rec = Recorder()
+    path = tmp_path / "spans.jsonl"
+    sink = JsonlSink(str(path), t0=rec.t0, max_bytes=512, keep=2)
+    rec.add_sink(sink)
+    _spin_spans(rec, 40)
+    sink.close()
+
+    rotated = sorted(p.name for p in tmp_path.iterdir())
+    assert "spans.jsonl.1" in rotated and "spans.jsonl.2" in rotated
+    assert "spans.jsonl.3" not in rotated  # keep=2 drops older files
+    line_len = None
+    for p in tmp_path.iterdir():
+        text = p.read_text()
+        lines = text.splitlines()
+        for line in lines:  # every record whole and parseable
+            entry = json.loads(line)
+            assert entry["name"] == "s"
+            line_len = len(line) + 1
+        if p.name != "spans.jsonl":
+            # A rotated file crossed the cap by at most one record.
+            assert 512 <= len(text) < 512 + line_len
+    # The live file was reopened fresh (below the cap).
+    assert len(path.read_text()) < 512
+
+
+def test_jsonl_sink_rotation_boundary_exact_hit(tmp_path):
+    """A write landing exactly ON the cap rotates too (>= semantics)."""
+    rec = Recorder()
+    path = tmp_path / "s.jsonl"
+    sink = JsonlSink(str(path), t0=rec.t0, max_bytes=1, keep=1)
+    rec.add_sink(sink)
+    _spin_spans(rec, 3)
+    sink.close()
+    # Every span rotated its file: the live file is empty, .1 has the
+    # last record whole.
+    assert path.read_text() == ""
+    assert len((tmp_path / "s.jsonl.1").read_text().splitlines()) == 1
+
+
+def test_jsonl_sink_rotation_rejects_file_objects(tmp_path):
+    import io
+
+    with pytest.raises(ValueError, match="path-owned"):
+        JsonlSink(io.StringIO(), max_bytes=100)
+
+
+# ---------------------------------------------------------------------------
+# Chrome counter tracks (live "C" samples)
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_counter_track_time_series():
+    """Each count() becomes a time-stamped "C" sample carrying the
+    cumulative value, so Perfetto renders an evolving counter track on
+    the span timeline (plus the final-value sample at the trace end)."""
+    from blance_tpu.obs import ChromeTraceSink
+
+    t = [10.0]
+    rec = Recorder(clock=lambda: t[0])
+    sink = ChromeTraceSink(rec)
+    rec.add_sink(sink)
+    rec.count("orchestrate.retries")
+    t[0] = 11.0
+    rec.count("orchestrate.retries", 2)
+    t[0] = 12.0
+    with rec.span("work"):
+        pass
+    events = sink.events(counters=dict(rec.counters))
+    c_events = [ev for ev in events if ev["ph"] == "C"]
+    live = [ev for ev in c_events if ev["name"] == "orchestrate.retries"]
+    # Two live samples (cumulative 1 then 3) + the final-value sample.
+    assert [ev["args"]["value"] for ev in live] == [1, 3, 3]
+    assert live[0]["ts"] == pytest.approx(0.0)
+    assert live[1]["ts"] == pytest.approx(1e6)  # 1 virtual second later
+    assert live[0]["ts"] <= live[1]["ts"] <= live[2]["ts"]

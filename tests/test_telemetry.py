@@ -1,0 +1,629 @@
+"""The live telemetry plane (PR 6): Prometheus exposition + registry,
+online SLO accounting, the calibrated move-cost model, and the
+determinism of all three under DeterministicLoop virtual time.
+
+Includes the metric-name drift guard: the MetricsRegistry table, the
+names actually emitted during a plan→diff→orchestrate pipeline run, and
+the docs/OBSERVABILITY.md metric table must stay mutually consistent.
+"""
+
+import asyncio
+import json
+import os
+import re
+
+import pytest
+
+from blance_tpu.core.types import Partition, PartitionModelState
+from blance_tpu.obs import (
+    CostModel,
+    MetricsServer,
+    Recorder,
+    SloTracker,
+    default_registry,
+    parse_prometheus,
+    render_prometheus,
+    scrape,
+    use_recorder,
+)
+from blance_tpu.orchestrate.faults import FaultPlan, NodeFaults
+from blance_tpu.orchestrate.orchestrator import (
+    OrchestratorOptions,
+    PartitionMove,
+    orchestrate_moves,
+)
+
+DOCS_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+
+
+def _pm(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+_MODEL2 = {"primary": PartitionModelState(priority=0, constraints=1),
+           "replica": PartitionModelState(priority=1, constraints=1)}
+
+
+# ---------------------------------------------------------------------------
+# Registry + rendering
+# ---------------------------------------------------------------------------
+
+
+def test_registry_declares_every_progress_counter():
+    from blance_tpu.orchestrate.orchestrator import OrchestratorProgress
+
+    reg = default_registry()
+    for field in OrchestratorProgress().__dict__:
+        if field == "errors":
+            continue
+        assert reg.declared("orchestrate." + field, "counter"), field
+
+
+def test_registry_rejects_duplicates_and_collisions():
+    from blance_tpu.obs import Metric, MetricsRegistry
+
+    with pytest.raises(ValueError, match="duplicate"):
+        MetricsRegistry([Metric("a.b", "counter", "x"),
+                         Metric("a.b", "counter", "y")])
+    with pytest.raises(ValueError, match="already taken"):
+        # Same prom name from two internal spellings.
+        MetricsRegistry([Metric("a.b", "gauge", "x"),
+                         Metric("a_b", "gauge", "y")])
+    with pytest.raises(ValueError, match="unknown kind"):
+        Metric("a.b", "summary", "x")
+
+
+def test_render_includes_every_declared_metric_and_parses():
+    rec = Recorder()
+    text = render_prometheus(rec)
+    samples, types = parse_prometheus(text)
+    reg = default_registry()
+    for m in reg.metrics():
+        pname = reg.prom_name(m)
+        assert types[pname] == m.kind, pname
+    # Empty recorder: every counter/gauge sample present and zero.
+    assert samples["blance_plan_solve_calls_total"] == 0
+    assert samples["blance_slo_partition_availability"] == 0
+    assert samples["blance_orchestrate_move_latency_s_count"] == 0
+    assert samples['blance_orchestrate_move_latency_s_bucket{le="+Inf"}'] == 0
+
+
+def test_render_histogram_buckets_cumulative_and_consistent():
+    rec = Recorder()
+    for v in (0.0004, 0.004, 0.004, 4.0):
+        rec.observe("orchestrate.move_latency_s", v)
+    samples, _ = parse_prometheus(render_prometheus(rec))
+    pre = "blance_orchestrate_move_latency_s"
+    assert samples[f'{pre}_bucket{{le="0.0005"}}'] == 1
+    assert samples[f'{pre}_bucket{{le="0.005"}}'] == 3
+    assert samples[f'{pre}_bucket{{le="+Inf"}}'] == 4
+    assert samples[f"{pre}_count"] == 4
+    assert samples[f"{pre}_sum"] == pytest.approx(4.0084)
+    # Buckets are monotone non-decreasing in le order.
+    buckets = [(float(m.group(1)), v) for k, v in samples.items()
+               if (m := re.match(rf'{pre}_bucket{{le="([0-9.e+-]+)"}}', k))]
+    buckets.sort()
+    assert all(a[1] <= b[1] for a, b in zip(buckets, buckets[1:]))
+
+
+def test_render_counter_and_labeled_gauge_samples():
+    rec = Recorder()
+    rec.count("orchestrate.retries", 7)
+    rec.set_gauge("slo.partition_availability", 0.25)
+    rec.set_gauge('slo.quarantine_exposure_s{node="n1"}', 1.5)
+    rec.set_gauge('slo.quarantine_exposure_s{node="n2"}', 2.5)
+    samples, _ = parse_prometheus(render_prometheus(rec))
+    assert samples["blance_orchestrate_retries_total"] == 7
+    assert samples["blance_slo_partition_availability"] == 0.25
+    assert samples['blance_slo_quarantine_exposure_s{node="n1"}'] == 1.5
+    assert samples['blance_slo_quarantine_exposure_s{node="n2"}'] == 2.5
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus("not a metric line at all\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("name notanumber\n")
+
+
+# ---------------------------------------------------------------------------
+# The asyncio endpoint (real loop: DeterministicLoop has no sockets)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_scrape_and_cache():
+    rec = Recorder()
+    rec.count("plan.solve.calls", 2)
+
+    async def main():
+        server = MetricsServer(recorder=rec, min_interval_s=0.0)
+        await server.start()
+        try:
+            text = await scrape("127.0.0.1", server.port)
+            s1, _ = parse_prometheus(text)
+            assert s1["blance_plan_solve_calls_total"] == 2
+            rec.count("plan.solve.calls", 3)
+            s2, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            assert s2["blance_plan_solve_calls_total"] == 5
+            with pytest.raises(RuntimeError, match="404"):
+                await scrape("127.0.0.1", server.port, path="/nope")
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_metrics_server_snapshot_throttling():
+    """Scrapes inside min_interval_s serve the cached snapshot; the
+    next snapshot after the interval sees the new values."""
+    t = [0.0]
+    rec = Recorder(clock=lambda: t[0])
+    rec.count("plan.solve.calls", 1)
+
+    async def main():
+        server = MetricsServer(recorder=rec, min_interval_s=10.0)
+        await server.start()
+        try:
+            s1, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            rec.count("plan.solve.calls", 1)
+            s2, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            assert s2["blance_plan_solve_calls_total"] == \
+                s1["blance_plan_solve_calls_total"] == 1  # cached
+            t[0] = 11.0
+            s3, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            assert s3["blance_plan_solve_calls_total"] == 2
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_metrics_server_collectors_run_per_snapshot():
+    rec = Recorder()
+    calls = []
+
+    def collector():
+        calls.append(1)
+        rec.set_gauge("slo.churn_ratio", float(len(calls)))
+
+    async def main():
+        server = MetricsServer(recorder=rec, min_interval_s=0.0,
+                               collectors=(collector,))
+        await server.start()
+        try:
+            s1, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            s2, _ = parse_prometheus(
+                await scrape("127.0.0.1", server.port))
+            assert s1["blance_slo_churn_ratio"] == 1
+            assert s2["blance_slo_churn_ratio"] == 2
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting
+# ---------------------------------------------------------------------------
+
+
+def _mv(partition, node, state, op="add"):
+    return PartitionMove(partition=partition, node=node, state=state, op=op)
+
+
+def test_slo_availability_incremental_math():
+    t = [0.0]
+    beg = _pm({"p0": {"primary": ["a"], "replica": ["b"]},
+               "p1": {"primary": ["a"]},
+               "p2": {"primary": []}})
+    slo = SloTracker(beg, primary_states=("primary",), clock=lambda: t[0],
+                     recorder=Recorder())
+    assert slo.availability() == pytest.approx(2 / 3)
+    # p2 gains a primary -> available.
+    slo.on_batch("b", [_mv("p2", "b", "primary")], ok=True, now=1.0)
+    assert slo.availability() == pytest.approx(1.0)
+    assert slo.moves_executed == 1
+    # p1's only primary demoted away -> unavailable.
+    slo.on_batch("a", [_mv("p1", "a", "replica", op="demote")],
+                 ok=True, now=2.0)
+    assert slo.availability() == pytest.approx(2 / 3)
+    # A removal ("" state, del op) on p0's primary; replica remains ->
+    # unavailable (no serving primary).
+    slo.on_batch("a", [_mv("p0", "a", "", op="del")], ok=True, now=3.0)
+    assert slo.availability() == pytest.approx(1 / 3)
+    # Failed batches change nothing but the failure count.
+    before = slo.availability()
+    slo.on_batch("b", [_mv("p1", "b", "primary")], ok=False, now=4.0)
+    assert slo.availability() == before
+    assert slo.moves_failed == 1 and slo.moves_executed == 3
+
+
+def test_slo_churn_and_lag_formulas():
+    t = [0.0]
+    beg = _pm({"p0": {"primary": ["a"]}})
+    slo = SloTracker(beg, clock=lambda: t[0], recorder=Recorder())
+    slo.set_min_moves(4)
+    slo.set_min_moves(99)  # first call wins (the PRIMARY plan)
+    assert slo.churn_ratio() == 0.0
+    slo.on_batch("b", [_mv("p0", "b", "primary"),
+                       _mv("p0", "a", "", op="del")], ok=True, now=2.0)
+    assert slo.churn_ratio() == pytest.approx(0.5)
+    t[0] = 7.5
+    assert slo.convergence_lag_s() == pytest.approx(5.5)
+    summary = slo.summary()
+    assert summary.moves_executed == 2 and summary.min_moves == 4
+    assert summary.convergence_lag_s == pytest.approx(5.5)
+
+
+def test_slo_strip_nodes_drops_availability():
+    beg = _pm({"p0": {"primary": ["dead"]},
+               "p1": {"primary": ["live"], "replica": ["dead"]}})
+    slo = SloTracker(beg, clock=lambda: 0.0, recorder=Recorder())
+    assert slo.availability() == 1.0
+    slo.strip_nodes({"dead"})
+    assert slo.availability() == pytest.approx(0.5)
+    assert slo.summary().available_partitions == 1
+
+
+def test_slo_publishes_gauges_to_recorder():
+    rec = Recorder()
+    beg = _pm({"p0": {"primary": ["a"]}})
+    slo = SloTracker(beg, clock=lambda: 0.0, recorder=rec)
+    slo.set_min_moves(1)
+    slo.on_batch("b", [_mv("p0", "b", "primary")], ok=True, now=0.0)
+    assert rec.gauges["slo.partition_availability"] == 1.0
+    assert rec.gauges["slo.churn_ratio"] == 1.0
+    assert rec.gauges["slo.moves_executed"] == 1.0
+
+
+def test_rebalance_result_carries_slo_summary():
+    """rebalance_async wires a tracker automatically; the clean-run
+    summary shows full availability and churn == 1."""
+    from blance_tpu.rebalance import rebalance
+
+    beg = _pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+    model = {"primary": PartitionModelState(priority=0, constraints=1)}
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    rec = Recorder()
+    with use_recorder(rec):
+        res = rebalance(model, beg, ["a", "b"], ["a"], [], assign,
+                        backend="greedy")
+    assert res.slo is not None
+    assert res.slo.availability == 1.0
+    assert res.slo.churn_ratio == pytest.approx(1.0)
+    assert res.slo.moves_executed == res.slo.min_moves > 0
+    assert rec.gauges["slo.partition_availability"] == 1.0
+
+
+def test_health_tracker_quarantine_exposure_accumulates():
+    from blance_tpu.orchestrate.health import HealthTracker
+
+    t = [0.0]
+    h = HealthTracker(threshold=1, probe_after_s=5.0, clock=lambda: t[0])
+    h.record_failure("n1")  # trips at t=0
+    t[0] = 3.0
+    assert h.exposure_s("n1") == pytest.approx(3.0)
+    t[0] = 6.0
+    assert h.admit("n1") == "probe"  # half-open still counts as exposed
+    h.record_failure("n1")  # re-trip at t=6: closes 6s into the total
+    t[0] = 8.0
+    assert h.exposure_s("n1") == pytest.approx(8.0)
+    h.record_success("n1")  # heal at t=8
+    t[0] = 100.0
+    assert h.exposure_s("n1") == pytest.approx(8.0)  # closed for good
+    assert h.exposures() == {"n1": pytest.approx(8.0)}
+    assert h.exposure_s("never") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+
+def _exec_span(rec, node, ops, seconds):
+    """Manufacture one orchestrate.move.exec span of a given duration."""
+    t0 = rec.now()
+    rec.record_span("orchestrate.move.exec", t0, t0 + seconds,
+                    task=f"mover:{node}", node=node, ops=",".join(ops))
+
+
+def test_costmodel_ewma_update_and_prediction_order():
+    rec = Recorder()
+    cm = CostModel(alpha=0.5, default_s=0.123, recorder=rec)
+    rec.add_sink(cm)
+    assert cm.predict("n1", "add") == 0.123  # cold start
+    _exec_span(rec, "n1", ["add"], 0.1)
+    assert cm.predict("n1", "add") == pytest.approx(0.1)
+    _exec_span(rec, "n1", ["add"], 0.2)
+    # ewma = 0.5*0.2 + 0.5*0.1
+    assert cm.predict("n1", "add") == pytest.approx(0.15)
+    # Unseen node falls back to the op aggregate, unseen op to global.
+    assert cm.predict("n9", "add") == pytest.approx(cm.predict("n9", "add"))
+    assert cm.predict("n9", "promote") > 0
+    assert rec.counters["costmodel.updates"] == 2
+    # The second update scored the first prediction's error.
+    cal = cm.calibration()
+    assert cal["scored"] == 1
+    assert cal["p50_rel_err"] == pytest.approx(abs(0.1 - 0.2) / 0.2)
+    assert rec.histogram_buckets("costmodel.rel_err")[2] == 1
+
+
+def test_costmodel_batch_amortizes_across_ops():
+    rec = Recorder()
+    cm = CostModel(recorder=rec)
+    rec.add_sink(cm)
+    _exec_span(rec, "n1", ["add", "del"], 0.2)  # 0.1 per move
+    assert cm.predict("n1", "add") == pytest.approx(0.1)
+    assert cm.predict("n1", "del") == pytest.approx(0.1)
+    assert cm.observations() == 2
+
+
+def test_costmodel_persistence_roundtrip(tmp_path):
+    rec = Recorder()
+    cm = CostModel(alpha=0.4, default_s=0.07, recorder=rec)
+    rec.add_sink(cm)
+    for node, op, s in (("n1", "add", 0.05), ("n1", "add", 0.09),
+                        ("n2", "del", 0.01), ("n3", "promote", 0.3)):
+        _exec_span(rec, node, [op], s)
+    path = str(tmp_path / "costs.json")
+    cm.save(path)
+    loaded = CostModel.load(path)
+    for node, op in [("n1", "add"), ("n2", "del"), ("n3", "promote"),
+                     ("n9", "add"), ("n9", "never")]:
+        assert loaded.predict(node, op) == cm.predict(node, op), (node, op)
+    # The file is the documented format.
+    data = json.load(open(path))
+    assert data["version"] == 1 and data["alpha"] == 0.4
+    assert {e["node"] for e in data["estimates"]} == {"n1", "n2", "n3"}
+    # A wrong version is a hard error.
+    data["version"] = 99
+    with pytest.raises(ValueError, match="version"):
+        CostModel.from_json(data)
+
+
+def test_costmodel_predict_move_duck_typing():
+    cm = CostModel(recorder=Recorder())
+    mv = _mv("p0", "n1", "primary")
+    assert cm.predict_move(mv) == cm.predict("n1", "add")
+
+
+def test_costmodel_learns_from_live_orchestration():
+    """End to end: attach the sink, orchestrate with per-node latency,
+    and the learned estimates reflect the structure."""
+    rec = Recorder()
+    cm = CostModel(alpha=0.5, recorder=rec)
+    rec.add_sink(cm)
+    beg = _pm({f"p{i}": {"primary": ["a"]} for i in range(6)})
+    end = _pm({f"p{i}": {"primary": ["b" if i % 2 else "c"]}
+               for i in range(6)})
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0.02 if node == "b" else 0.001)
+
+    async def run():
+        with use_recorder(rec):
+            o = orchestrate_moves(
+                {"primary": PartitionModelState(priority=0, constraints=1)},
+                OrchestratorOptions(), ["a", "b", "c"], beg, end, assign)
+            async for _ in o.progress_ch():
+                pass
+            o.stop()
+
+    asyncio.run(run())
+    assert cm.observations() > 0
+    # The slow node costs measurably more than the fast one.
+    assert cm.predict("b", "add") > cm.predict("c", "add")
+
+
+# ---------------------------------------------------------------------------
+# Metric-name drift guard (registry <-> emissions <-> docs)
+# ---------------------------------------------------------------------------
+
+
+def _doc_metric_rows():
+    """Parse the docs/OBSERVABILITY.md 'Metric reference' table into
+    (name_or_wildcard, kind) rows."""
+    text = open(DOCS_PATH).read()
+    section = text.split("### Metric reference", 1)[1]
+    rows = []
+    for line in section.splitlines():
+        m = re.match(r"\|\s*`([a-z0-9_.*]+)`\s*\|\s*(\w+)\s*\|", line)
+        if m:
+            rows.append((m.group(1), m.group(2)))
+        elif rows and line.strip() and not line.startswith("|"):
+            break  # table ended
+    return rows
+
+
+def _row_matches(row_name, metric_name):
+    if row_name.endswith("*"):
+        return metric_name.startswith(row_name[:-1])
+    return row_name == metric_name
+
+
+def test_drift_guard_docs_table_matches_registry():
+    """No stale doc rows; no undocumented registry metrics."""
+    reg = default_registry()
+    rows = _doc_metric_rows()
+    assert rows, "docs metric table not found"
+    names_by_kind = {(m.name, m.kind) for m in reg.metrics()}
+    for row_name, row_kind in rows:
+        hits = [(n, k) for (n, k) in names_by_kind
+                if k == row_kind and _row_matches(row_name, n)]
+        assert hits, f"stale docs row: {row_name} ({row_kind}) matches " \
+                     f"no registry metric"
+    for name, kind in names_by_kind:
+        documented = any(k == kind and _row_matches(rn, name)
+                         for rn, k in rows)
+        assert documented, f"registry metric {name} ({kind}) missing " \
+                           f"from the docs table"
+
+
+def test_drift_guard_pipeline_emissions_all_declared():
+    """A full plan→diff→orchestrate(+chaos rebalance, SLO, cost model)
+    run emits ONLY declared metric names — no undeclared emissions."""
+    from blance_tpu.moves.batch import calc_all_moves
+    from blance_tpu.plan.api import plan_next_map
+    from blance_tpu.rebalance import rebalance
+
+    rec = Recorder()
+    cm = CostModel(recorder=rec)
+    rec.add_sink(cm)
+    nodes = [f"n{i}" for i in range(6)]
+    beg = _pm({str(i): {"primary": [nodes[i % 5]],
+                        "replica": [nodes[(i + 1) % 5]]}
+               for i in range(24)})
+    with use_recorder(rec):
+        # plan: both the tensor path (plan.* spans/counters) and greedy
+        # (plan.greedy.*), then the batched device diff (moves.*).
+        end, _ = plan_next_map(beg, beg, nodes, [nodes[0]], [], _MODEL2,
+                               None, backend="tpu")
+        plan_next_map(beg, beg, nodes, [], [], _MODEL2, None,
+                      backend="greedy")
+        calc_all_moves(beg, end, _MODEL2)
+
+        plan = FaultPlan(seed=3, nodes={
+            nodes[5]: NodeFaults(dead=True),
+            nodes[1]: NodeFaults(fail_rate=0.3)})
+
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0)
+
+        rebalance(_MODEL2, beg, nodes, [nodes[2]], [nodes[5]],
+                  plan.wrap(assign),
+                  orchestrator_options=OrchestratorOptions(
+                      move_timeout_s=0.25, max_retries=3,
+                      backoff_base_s=0.001, quarantine_after=2,
+                      probe_after_s=60.0),
+                  max_recovery_rounds=2, backend="greedy")
+
+    reg = default_registry()
+    assert reg.undeclared(rec) == []
+    # And the run actually exercised the fault + slo + costmodel groups,
+    # so the check above had teeth.
+    assert rec.counters.get("orchestrate.move_failures", 0) > 0
+    assert rec.counters.get("costmodel.updates", 0) > 0
+    assert "slo.partition_availability" in rec.gauges
+
+
+# ---------------------------------------------------------------------------
+# Virtual-time determinism (DeterministicLoop + injectable clock)
+# ---------------------------------------------------------------------------
+
+
+def _vt_chaos_scenario():
+    """A chaos rebalance whose ENTIRE telemetry runs on virtual time;
+    returns (exposition text, slo summary dict) for bit-comparison."""
+
+    async def scenario():
+        import dataclasses
+
+        from blance_tpu.rebalance import rebalance_async
+
+        loop = asyncio.get_running_loop()
+        rec = Recorder(clock=loop.time)
+        nodes = [f"n{i}" for i in range(5)]
+        beg = _pm({f"{i:02d}": {"primary": [nodes[i % 3]],
+                                "replica": [nodes[(i + 1) % 3]]}
+                   for i in range(12)})
+        plan = FaultPlan(seed=21, nodes={
+            nodes[4]: NodeFaults(dead=True),
+            nodes[0]: NodeFaults(fail_rate=0.3)})
+
+        async def assign(stop_ch, node, partitions, states, ops):
+            await asyncio.sleep(0.002)  # virtual-time data plane
+
+        with use_recorder(rec):
+            slo = SloTracker(beg, primary_states=("primary",),
+                             clock=rec.now, recorder=rec)
+            result = await rebalance_async(
+                _MODEL2, beg, nodes, [nodes[1]], [nodes[4]],
+                plan.wrap(assign),
+                orchestrator_options=OrchestratorOptions(
+                    move_timeout_s=0.25, max_retries=3,
+                    backoff_base_s=0.002, quarantine_after=2,
+                    probe_after_s=60.0),
+                max_recovery_rounds=2, backend="greedy", slo=slo)
+            text = render_prometheus(rec)
+        assert result.slo is not None
+        return text, dataclasses.asdict(result.slo)
+
+    return scenario()
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_slo_gauges_bit_identical_across_seeded_runs(seed):
+    """The acceptance contract: under DeterministicLoop, two runs of
+    the same seed reproduce the SLO gauges — and the ENTIRE rendered
+    exposition text, histograms included — bit-identically."""
+    from blance_tpu.testing.sched import RandomWalkPolicy, run_controlled
+
+    out_a = run_controlled(_vt_chaos_scenario, RandomWalkPolicy(seed))
+    out_b = run_controlled(_vt_chaos_scenario, RandomWalkPolicy(seed))
+    assert out_a.ok, out_a.describe()
+    assert out_b.ok, out_b.describe()
+    text_a, slo_a = out_a.result
+    text_b, slo_b = out_b.result
+    assert slo_a == slo_b
+    assert text_a == text_b
+    # The gauges are meaningful, not vacuously equal.
+    samples, _ = parse_prometheus(text_a)
+    assert 0.0 <= samples["blance_slo_partition_availability"] <= 1.0
+    assert samples["blance_slo_moves_executed"] > 0
+    assert samples["blance_orchestrate_move_latency_s_count"] > 0
+    # The dead node's quarantine exposure is real VIRTUAL dwell, not a
+    # cross-clock subtraction clamped to zero (the breaker shares the
+    # recorder's injected clock).
+    assert any(v > 0 for v in slo_a["quarantine_exposure_s"].values()), \
+        slo_a["quarantine_exposure_s"]
+
+
+def test_vt_exposition_snapshot_deterministic_mid_run():
+    """Exposition snapshots taken DURING the run (not just at the end)
+    are schedule-deterministic too: same seed, same mid-run text."""
+    from blance_tpu.testing.sched import RandomWalkPolicy, run_controlled
+
+    def factory():
+        async def scenario():
+            loop = asyncio.get_running_loop()
+            rec = Recorder(clock=loop.time)
+            beg = _pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+            end = _pm({f"p{i}": {"primary": ["b"]} for i in range(4)})
+            snapshots = []
+
+            async def assign(stop_ch, node, partitions, states, ops):
+                await asyncio.sleep(0.001)
+
+            with use_recorder(rec):
+                slo = SloTracker(beg, clock=rec.now, recorder=rec)
+                server = MetricsServer(recorder=rec, min_interval_s=0.0,
+                                       collectors=(slo.publish,))
+                o = orchestrate_moves(
+                    {"primary": PartitionModelState(priority=0,
+                                                    constraints=1)},
+                    OrchestratorOptions(), ["a", "b"], beg, end, assign,
+                    move_observers=(slo,))
+                o.visit_next_moves(lambda m: slo.set_min_moves(
+                    sum(len(nm.moves) for nm in m.values())))
+                async for _ in o.progress_ch():
+                    snapshots.append(server.render())
+                o.stop()
+            return snapshots
+
+        return scenario()
+
+    a = run_controlled(factory, RandomWalkPolicy(37))
+    b = run_controlled(factory, RandomWalkPolicy(37))
+    assert a.ok and b.ok
+    assert a.result == b.result
+    assert len(a.result) > 3
