@@ -95,6 +95,15 @@ SHARED_STATE: dict[str, frozenset[str]] = {
     }),
     "CostModel": frozenset({"_est", "_op_est", "_global", "_errors",
                             "_n_scored"}),
+    # -- fleet plan service (PR 7) ------------------------------------------
+    # PlanService's control state is touched by the app-facing surface
+    # (submit/stop) and the dispatcher task; every mutation sits in one
+    # no-await window, and the bounded queue is the only rendezvous.
+    # The CarryCache is written ONLY from the dispatcher task (sessions
+    # own private caches), a discipline this entry documents — any
+    # future async method on either class puts it under RACE001/002.
+    "PlanService": frozenset({"_queue", "_task", "_closed", "_executor"}),
+    "CarryCache": frozenset({"_entries", "_clock", "_bytes"}),
 }
 
 # Container mutators: a call to one of these on a shared attribute is a

@@ -19,6 +19,9 @@ Covered entry points (acceptance contract):
   adds host gates around exactly this traced core)
 - sharded solve              — ``solve_dense`` under ``shard_map`` with
   the partition axis sharded, the layout solve_dense_sharded builds
+- fleet batch solves         — ``plan.fleet._fleet_cold_batch`` /
+  ``_fleet_warm_batch``, the vmapped bucket-class programs the
+  multi-tenant tier dispatches (stacked ``[B, ...]`` layouts)
 - carry construction         — ``carry_from_assignment`` / ``_carry_used_jit``
 - ``encode_problem`` / ``decode_assignment`` — dense-encoding dtypes and
   the decode round trip (tiny concrete problem; host-only, milliseconds)
@@ -224,6 +227,72 @@ def _bucketed_dims(d: Dims) -> Dims:
                 L=d.L)
 
 
+_FLEET_B = 4  # batch width for the fleet contracts
+
+
+def _fleet_args(d: Dims, b: int):
+    """The stacked [B, ...] operands a fleet batch class solves."""
+    import numpy as np
+
+    return (
+        _sds((b, d.P, d.S, d.R), np.int32),  # prev
+        _sds((b, d.P), np.float32),  # pweights
+        _sds((b, d.N), np.float32),  # nweights
+        _sds((b, d.N), np.bool_),  # valid
+        _sds((b, d.P, d.S), np.float32),  # stickiness
+        _sds((b, d.L, d.N), np.int32),  # gids
+        _sds((b, d.L, d.N), np.bool_),  # gid_valid
+    )
+
+
+def _build_fleet_cold(d: Dims, b: int = _FLEET_B):
+    """plan.fleet._fleet_cold_batch: the vmapped converged fixpoint
+    over one bucket class — (assign, sweeps, carry-used) per element."""
+    import numpy as np
+
+    from ..plan.fleet import _fleet_cold_batch
+
+    db = _bucketed_dims(d)
+    args = _fleet_args(db, b) + (_sds((b,), np.float32),)  # p_real
+    return _fleet_cold_batch, args, {
+        "constraints": db.constraints, "rules": db.rules,
+        "max_iterations": 4, "fused_score": "off"}
+
+
+def _build_fleet_warm(d: Dims, b: int = _FLEET_B):
+    """plan.fleet._fleet_warm_batch: the vmapped one-sweep repair —
+    (assign, new_used, accept flag) per element."""
+    import numpy as np
+
+    from ..plan.fleet import _fleet_warm_batch
+
+    db = _bucketed_dims(d)
+    args = _fleet_args(db, b) + (
+        _sds((b, db.P), np.bool_),  # dirty
+        _sds((b, db.S, db.N), np.float32),  # carry_used
+        _sds((b,), np.float32),  # p_real
+    )
+    return _fleet_warm_batch, args, {
+        "constraints": db.constraints, "rules": db.rules,
+        "fused_score": "off"}
+
+
+def _expect_fleet_cold(d: Dims, b: int = _FLEET_B):
+    import numpy as np
+
+    db = _bucketed_dims(d)
+    return (((b, db.P, db.S, db.R), np.int32), ((b,), np.int32),
+            ((b, db.S, db.N), np.float32))
+
+
+def _expect_fleet_warm(d: Dims, b: int = _FLEET_B):
+    import numpy as np
+
+    db = _bucketed_dims(d)
+    return (((b, db.P, db.S, db.R), np.int32),
+            ((b, db.S, db.N), np.float32), ((b,), np.bool_))
+
+
 # -- the table --------------------------------------------------------------
 
 # The audit matrix: small/typical/awkward sizes.  P values are multiples
@@ -295,6 +364,20 @@ CONTRACTS: tuple[ShapeContract, ...] = tuple(
             entry="solve_dense_sharded", variant=f"1d@{d.P}x{d.N}",
             build=(lambda d=d: _build_sharded(d)),
             expect=(lambda d=d: _expect_assign(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="fleet_cold_batch",
+            variant=f"B{_FLEET_B}@{d.P}x{d.N}",
+            build=(lambda d=d: _build_fleet_cold(d)),
+            expect=(lambda d=d: _expect_fleet_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="fleet_warm_batch",
+            variant=f"B{_FLEET_B}@{d.P}x{d.N}",
+            build=(lambda d=d: _build_fleet_warm(d)),
+            expect=(lambda d=d: _expect_fleet_warm(d)))
         for d in _MATRIX
     ]
 )

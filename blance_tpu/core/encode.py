@@ -34,7 +34,8 @@ from .types import (
 )
 
 __all__ = ["DenseProblem", "encode_problem", "decode_assignment",
-           "bucket_size", "pad_to"]
+           "bucket_size", "pad_to", "pad_problem_arrays",
+           "stack_problem_arrays"]
 
 # Shape-bucket granularity: buckets per power-of-two octave.  8 keeps the
 # worst-case padding overhead at 1/8 = 12.5% of the axis while collapsing
@@ -73,6 +74,58 @@ def pad_to(arr: np.ndarray, axis: int, target: int,
     pad_shape[axis] = target - cur
     return np.concatenate(
         [arr, np.full(pad_shape, fill, arr.dtype)], axis=axis)
+
+
+def pad_problem_arrays(
+    prev: np.ndarray,
+    partition_weights: np.ndarray,
+    node_weights: np.ndarray,
+    valid_node: np.ndarray,
+    stickiness: np.ndarray,
+    gids: np.ndarray,
+    gid_valid: np.ndarray,
+    p_target: int,
+    n_target: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+           np.ndarray, np.ndarray]:
+    """Pad one problem's solver arrays to (p_target, n_target), inertly.
+
+    THE bit-neutral padding recipe, shared by plan_next_map_tpu's
+    shape-bucketed path and the fleet batch stacker (plan/fleet.py):
+    pad partitions are weight-0 bidders (their assignments are sliced
+    off by the caller) and pad nodes invalid (valid=False => zero
+    capacity, +INF score, gid_valid=False), the same inert-padding
+    contract parallel/sharded.py relies on, so the real rows solve
+    identically to the unpadded problem.  Parameters and the returned
+    tuple both follow the solver's positional order (prev, pweights,
+    nweights, valid, stickiness, gids, gid_valid) so the call sites
+    splat straight into solve_dense and friends."""
+    prev = pad_to(prev, 0, p_target, -1)
+    partition_weights = pad_to(partition_weights, 0, p_target, 0.0)
+    stickiness = pad_to(stickiness, 0, p_target, 0.0)
+    node_weights = pad_to(node_weights, 0, n_target, 1.0)
+    valid_node = pad_to(valid_node, 0, n_target, False)
+    gids = pad_to(gids, 1, n_target, -1)
+    gid_valid = pad_to(gid_valid, 1, n_target, False)
+    return (prev, partition_weights, node_weights, valid_node,
+            stickiness, gids, gid_valid)
+
+
+def stack_problem_arrays(
+    padded: "list[tuple[np.ndarray, ...]]",
+) -> tuple[np.ndarray, ...]:
+    """Stack B same-shape padded array tuples into [B, ...] batch
+    tensors (one np.stack per operand, solver positional order
+    preserved).  The batch analog of pad_problem_arrays: pad first so
+    every element of a bucket class shares its static shape, then
+    stack — the [B, P, S, N] problem tensor the fleet solver vmaps
+    over."""
+    if not padded:
+        raise ValueError("stack_problem_arrays: empty batch")
+    width = len(padded[0])
+    return tuple(
+        np.stack([np.asarray(arrs[i]) for arrs in padded])
+        for i in range(width))
 
 
 @dataclass

@@ -235,6 +235,26 @@ def default_registry() -> MetricsRegistry:
         Metric("costmodel.rel_err", "histogram",
                "relative error of the cost prediction vs the observed "
                "per-move cost, at update time"),
+        # -- fleet (plan/fleet.py + plan/service.py) -------------------------
+        Metric("fleet.requests", "counter",
+               "tenant plan requests submitted to the plan service"),
+        Metric("fleet.batches", "counter",
+               "fleet batch device dispatches (one per bucket class x "
+               "warm/cold)"),
+        Metric("fleet.dispatcher_crashes", "counter",
+               "plan-service dispatcher tasks that died with an escaped "
+               "exception"),
+        Metric("fleet.queue_depth", "gauge",
+               "plan requests waiting in the service's bounded queue"),
+        Metric("fleet.batch_tenants", "histogram",
+               "real tenants per fleet batch dispatch"),
+        Metric("fleet.batch_occupancy", "histogram",
+               "real tenants / padded batch size per dispatch (mesh "
+               "divisibility padding included)"),
+        Metric("fleet.admission_latency_s", "histogram",
+               "seconds from plan-service submit to resolved result"),
+        Metric("fleet.dispatch_s", "histogram",
+               "wall-clock seconds per fleet batch device dispatch"),
     ]
     metrics.extend(
         Metric("orchestrate." + name, "counter",

@@ -46,6 +46,7 @@ from ..core.types import (
     PartitionModel,
     PlanOptions,
 )
+from .carry import CarryCache, capacity_shrank, effective_dirty
 
 if TYPE_CHECKING:  # annotation-only: keep jax imports lazy at runtime
     from jax.sharding import Mesh
@@ -77,6 +78,8 @@ class PlannerSession:
         partitions: list[str],
         opts: Optional[PlanOptions] = None,
         mesh: Optional["Mesh"] = None,
+        carry_cache: Optional[CarryCache] = None,
+        cache_key: str = "session",
     ) -> None:
         self.model = model
         self.opts = opts or PlanOptions()
@@ -88,21 +91,21 @@ class PlannerSession:
         # current/proposed dense assignments [P, S, R] int32, -1 = empty.
         self.current = self._problem.prev.copy()
         self.proposed: Optional[np.ndarray] = None
-        # Warm-start state (docs/DESIGN.md "Incremental replanning"):
-        # _carry is the SolveCarry matching ``current`` (valid iff
-        # _carry_current is literally the ``current`` array it was built
-        # against — identity, because every adoption path replaces the
-        # array); _pending_carry is the carry of ``proposed``, promoted
-        # by apply(); _dirty marks partitions a delta since the carry
-        # was built may move, and _dirty_post the marks from deltas
-        # recorded AFTER the pending proposal was solved (the proposal
-        # did not absorb those, so apply() must carry them forward, not
-        # clear them).
-        self._carry = None
-        self._carry_current: Optional[np.ndarray] = None
-        self._pending_carry = None
-        self._dirty = np.zeros(len(self._partition_names), bool)
-        self._dirty_post = np.zeros(len(self._partition_names), bool)
+        # Warm-start state (docs/DESIGN.md "Incremental replanning") now
+        # lives in a plan.carry.CarryCache entry — the session is a thin
+        # view over one key.  The entry holds the SolveCarry matching
+        # ``current`` (valid iff entry.current is literally the
+        # ``current`` array it was built against — identity, because
+        # every adoption path replaces the array), the pending carry of
+        # ``proposed`` (promoted by apply()), and the dirty/dirty-post
+        # masks (marks recorded after the pending proposal was solved
+        # carry forward on apply(), not clear).  A shared cache (the
+        # plan service's per-tenant store) can be passed in; by default
+        # each session owns a private, unbounded one.
+        self._carries = carry_cache if carry_cache is not None \
+            else CarryCache()
+        self._ckey = cache_key
+        self._carries.entry(self._ckey, len(self._partition_names))
 
     # -- encoding ------------------------------------------------------------
 
@@ -155,9 +158,9 @@ class PlannerSession:
                     -1, np.int32)
                 current = np.concatenate([current, pad], axis=2)
                 # ``current`` was replaced; the carry no longer matches
-                # any live assignment array.
-                self._carry = None
-                self._carry_current = None
+                # any live assignment array (the recorded delta masks
+                # still do — only the carry drops).
+                self._carries.drop_carry_keep_dirty(self._ckey)
             self.current = current
             self._pad_carry_nodes()
             self._mark_dirty_for_added(
@@ -202,23 +205,25 @@ class PlannerSession:
         Called automatically on load_map / weight changes; call it
         manually after mutating ``current``, ``opts``, or the problem
         arrays directly."""
-        self._carry = None
-        self._carry_current = None
-        self._pending_carry = None
-        self._dirty = np.zeros(len(self._partition_names), bool)
-        self._dirty_post = np.zeros(len(self._partition_names), bool)
+        self._carries.invalidate(self._ckey)
 
-    # -- warm-start internals -------------------------------------------------
+    # -- warm-start internals (thin views over the CarryCache entry) ---------
+
+    @property
+    def _carry(self) -> Optional["SolveCarry"]:
+        """The live warm carry (None = the next replan solves cold).
+        Read-only view for callers/tests; the lifecycle lives in
+        plan.carry.CarryCache."""
+        e = self._carries.peek(self._ckey)
+        return e.carry if e is not None else None
 
     def _mark_dirty(self, mask: np.ndarray) -> None:
         """Record delta marks.  Marks land in the post-proposal mask
         while a proposal is pending: the pending solve did not see this
         delta, so apply() must carry these forward instead of clearing
         them with the absorbed ones."""
-        if self.proposed is not None:
-            self._dirty_post |= mask
-        else:
-            self._dirty |= mask
+        self._carries.mark_dirty(self._ckey, mask,
+                                 pending=self.proposed is not None)
 
     def _pad_carry_nodes(self) -> None:
         """Grow the carries' [N]-shaped arrays after add_nodes: fresh
@@ -226,25 +231,7 @@ class PlannerSession:
         live carry and the pending one (a delta can land between
         replan() and apply(), and apply() will promote the pending
         carry into the grown problem)."""
-        n = self._problem.N
-        self._carry = self._pad_one_carry(self._carry, n)
-        self._pending_carry = self._pad_one_carry(self._pending_carry, n)
-
-    @staticmethod
-    def _pad_one_carry(carry: Optional["SolveCarry"],
-                       n: int) -> Optional["SolveCarry"]:
-        if carry is None:
-            return None
-        used = np.asarray(carry.used)
-        if used.shape[1] >= n:
-            return carry
-        from .tensor import SolveCarry
-
-        used = np.concatenate(
-            [used, np.zeros((used.shape[0], n - used.shape[1]),
-                            used.dtype)], axis=1)
-        return SolveCarry(prices=used.sum(axis=0), assign=carry.assign,
-                          used=used)
+        self._carries.pad_nodes(self._ckey, self._problem.N)
 
     def _mark_dirty_for_added(self, new_ids: list[int]) -> None:
         """Adds can improve a partition's attainable rule tier: any
@@ -269,68 +256,23 @@ class PlannerSession:
                     self._mark_dirty(
                         ((prob.gids[lv][cur] == g) & held).any(axis=(1, 2)))
 
-    def _effective_dirty(self) -> np.ndarray:
-        """The replan-time dirty mask: accumulated delta rows plus any
-        partition with an unfilled constrained slot (it must bid)."""
-        prob = self._problem
-        d = self._dirty.copy()
-        r = self.current.shape[2]
-        for si in range(prob.S):
-            k = min(int(prob.constraints[si]), r)
-            if k > 0:
-                d |= (self.current[:, si, :k] < 0).any(axis=1)
-        return d
-
     def _capacity_shrank(self, carry: "SolveCarry",
                          dirty: np.ndarray) -> bool:
-        """True when some node's clean-row held weight exceeds its new
-        per-state capacity rail — the pin pass would then trim (displace)
-        holders OUTSIDE the dirty mask, so a warm repair cannot be
-        accepted and the cold solve should run directly (skipping the
-        wasted repair sweep).  O(N + dirty) host work off the carry.
-
-        Grants the same quantization allowance as the device-side
-        acceptance check (plan/tensor.py _warm_repair): a converged
-        fixpoint legitimately overshoots the ceil'd rail by up to one
-        max-weight partition per shard (the auction's first-bidder
-        progress rule) and replans unchanged, so flagging that steady
-        state would silently demote every replan of such a session to
-        cold.  A mis-grant only costs a wasted repair sweep — the
-        in-graph ripple check still falls back when the trim actually
-        displaces clean holders."""
+        """Host-side warm-decline precheck, delegated to
+        plan.carry.capacity_shrank (the extracted spelling the fleet
+        tier shares); the session contributes its mesh shard count for
+        the quantization allowance."""
         prob = self._problem
-        used = np.asarray(carry.used)
-        pw = prob.partition_weights
-        total_w = float(pw.sum())
-        cap_w = np.where(
-            prob.valid_node & (prob.node_weights >= 0),
-            np.maximum(prob.node_weights, 1.0), 0.0).astype(np.float64)
-        share = cap_w / max(cap_w.sum(), 1.0)
-        r = self.current.shape[2]
-        any_dirty = bool(dirty.any())
         shards = 1
         if self.mesh is not None:
             from ..parallel.sharded import PARTITION_AXIS
 
             axes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
             shards = axes.get(PARTITION_AXIS, 1)
-        allowance = shards * (float(pw.max()) if pw.size else 0.0)
-        for si in range(prob.S):
-            k = int(prob.constraints[si])
-            if k <= 0:
-                continue
-            held = used[si].astype(np.float64).copy()
-            if any_dirty:
-                # Dirty rows re-bid regardless; their held weight cannot
-                # pin, so it does not count against the rail.
-                ids = self.current[dirty, si, :].ravel()
-                w = np.repeat(pw[dirty], r)
-                m = ids >= 0
-                np.subtract.at(held, ids[m], w[m])
-            cap = np.ceil(k * total_w * share)
-            if (held > cap + allowance + 1e-6).any():
-                return True
-        return False
+        return capacity_shrank(
+            np.asarray(carry.used), self.current, prob.partition_weights,
+            prob.node_weights, prob.valid_node, prob.constraints, dirty,
+            shards=shards)
 
     @property
     def nodes(self) -> list[str]:
@@ -421,21 +363,18 @@ class PlannerSession:
         iters = max(int(self.opts.max_iterations), 1)
         mode = resolve_default_fused_score(prob.P, prob.N)
 
-        # This solve absorbs every delta recorded so far — including any
-        # that arrived after a previous (unapplied) proposal.
-        self._dirty |= self._dirty_post
-        self._dirty_post[:] = False
-
         # Warm attempt: consume the carry (its buffers may be donated
-        # into the repair), accept only a delta-contained repair.
-        carry, self._carry = self._carry, None
-        warm_ok = carry is not None and self._carry_current is self.current
-        if not warm_ok:
+        # into the repair), accept only a delta-contained repair.  The
+        # consume merges post-proposal marks first — this solve absorbs
+        # every delta recorded so far, including any that arrived after
+        # a previous (unapplied) proposal.
+        carry, dirty_base = self._carries.consume(self._ckey, self.current)
+        if carry is None:
             rec.count("plan.solve.carry_miss")
-        self._carry_current = None
         assign = new_carry = None
-        if warm_ok:
-            dirty = self._effective_dirty()
+        if carry is not None:
+            dirty = effective_dirty(dirty_base, self.current,
+                                    prob.constraints)
             if self._capacity_shrank(carry, dirty):
                 # Grown cluster: the trim pass will displace clean
                 # holders — the repair could never be accepted, so skip
@@ -485,7 +424,7 @@ class PlannerSession:
         maybe_validate(prob, assign, self.opts.validate_assignment,
                        "PlannerSession.replan")
         self.proposed = assign
-        self._pending_carry = new_carry
+        self._carries.store_pending(self._ckey, new_carry)
         return assign
 
     def _warm_solve(
@@ -604,9 +543,4 @@ class PlannerSession:
             raise ValueError("no proposed assignment; call replan() first")
         self.current = self.proposed
         self.proposed = None
-        self._carry = self._pending_carry
-        self._carry_current = self.current if self._carry is not None \
-            else None
-        self._pending_carry = None
-        self._dirty = self._dirty_post
-        self._dirty_post = np.zeros(len(self._partition_names), bool)
+        self._carries.promote(self._ckey, self.current)

@@ -2232,17 +2232,13 @@ def plan_next_map_tpu(
     gv_a = problem.gid_valid
     solve_p, solve_n = problem.P, problem.N
     if opts.shape_bucketing:
-        from ..core.encode import bucket_size, pad_to
+        from ..core.encode import bucket_size, pad_problem_arrays
 
         solve_p = bucket_size(problem.P)
         solve_n = bucket_size(problem.N)
-        prev_a = pad_to(prev_a, 0, solve_p, -1)
-        pw_a = pad_to(pw_a, 0, solve_p, 0.0)
-        stick_a = pad_to(stick_a, 0, solve_p, 0.0)
-        nw_a = pad_to(nw_a, 0, solve_n, 1.0)
-        valid_a = pad_to(valid_a, 0, solve_n, False)
-        gids_a = pad_to(gids_a, 1, solve_n, -1)
-        gv_a = pad_to(gv_a, 1, solve_n, False)
+        (prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a) = \
+            pad_problem_arrays(prev_a, pw_a, nw_a, valid_a, stick_a,
+                               gids_a, gv_a, solve_p, solve_n)
 
     with phase_span("plan.solve", timer=timer,
                     partitions=problem.P, nodes=problem.N,

@@ -590,6 +590,8 @@ def test_race_lint_real_package_model_matches_reality():
     import blance_tpu.orchestrate.csp as csp
     import blance_tpu.orchestrate.health as health
     import blance_tpu.orchestrate.orchestrator as orch
+    import blance_tpu.plan.carry as plancarry
+    import blance_tpu.plan.service as planservice
     from blance_tpu.analysis.race_lint import SHARED_STATE
 
     import inspect
@@ -604,6 +606,8 @@ def test_race_lint_real_package_model_matches_reality():
         "NextMoves": inspect.getsource(orch.NextMoves),
         "SloTracker": inspect.getsource(slo.SloTracker),
         "CostModel": inspect.getsource(costmodel.CostModel),
+        "PlanService": inspect.getsource(planservice.PlanService),
+        "CarryCache": inspect.getsource(plancarry.CarryCache),
     }
     for cls, attrs in SHARED_STATE.items():
         src = sources[cls]
