@@ -30,6 +30,10 @@ blance_tpu's own static layer, run as the ``static`` CI tier:
   solver's public entry points, checked with ``jax.eval_shape`` across a
   (P, S, N, R) x bucketing x carry matrix: zero FLOPs, seconds of
   wall-clock, catches shape/dtype drift before any device sees it.
+- :mod:`.retrace` — the device-side jit-cache contract: per-entry-point
+  XLA compile budgets for a canonical workload, counted with
+  ``obs/device.py``'s attributed CompileMonitor (DEV001 over budget,
+  DEV002 unbudgeted entry).
 - :mod:`.baseline` — the accepted-findings allowlist
   (``analysis/baseline.toml``): pre-existing findings are pinned with a
   reason; any NEW finding fails the build.
@@ -90,6 +94,7 @@ class AnalysisResult:
     unused_baseline: list[Any]
     checked_files: int = 0
     shape_entries: int = 0
+    retrace_entries: int = 0
     # analyzer crashes (fatal)
     errors: list[str] = field(default_factory=list)
 
@@ -142,13 +147,16 @@ def run_all(
     paths: Optional[list[str]] = None,
     baseline_path: Optional[str] = None,
     shape_audit: bool = True,
+    retrace: bool = False,
 ) -> AnalysisResult:
-    """Lints + (optionally) the eval_shape audit, folded through the
-    baseline.  The CLI and the CI gate both call this."""
+    """Lints + (optionally) the eval_shape audit and the retrace-budget
+    check, folded through the baseline.  The CLI and the CI gate both
+    call this."""
     from .baseline import Baseline
 
     findings, nfiles = run_lints(paths)
     shape_entries = 0
+    retrace_entries = 0
     errors: list[str] = []
     if shape_audit:
         from .shape_audit import run_shape_audit
@@ -158,6 +166,15 @@ def run_all(
             findings.extend(shape_findings)
         except Exception as e:  # an analyzer crash is itself a failure
             errors.append(f"shape audit crashed: {type(e).__name__}: {e}")
+    if retrace:
+        from .retrace import run_retrace_check
+
+        try:
+            retrace_findings, retrace_entries = run_retrace_check()
+            findings.extend(retrace_findings)
+        except Exception as e:
+            errors.append(
+                f"retrace check crashed: {type(e).__name__}: {e}")
 
     if baseline_path is None:
         baseline_path = os.path.join(
@@ -170,5 +187,6 @@ def run_all(
         unused_baseline=baseline.unused(),
         checked_files=nfiles,
         shape_entries=shape_entries,
+        retrace_entries=retrace_entries,
         errors=errors,
     )

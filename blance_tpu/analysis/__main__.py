@@ -3,11 +3,12 @@
 Exit status is the contract CI consumes: 0 when every finding is either
 fixed or pinned in analysis/baseline.toml, nonzero when any NEW finding
 exists (or an analyzer itself crashed).  ``--ci`` is the full gate (AST
-lints + eval_shape audit) and additionally promotes stale baseline
-entries to hard errors, so a fix that removes a finding must delete its
-suppression in the same change; the default run skips the shape audit
-so the editor loop stays sub-second and jax-import-free
-(``--shape-audit`` forces it back on).
+lints + eval_shape audit + the device retrace-budget check) and
+additionally promotes stale baseline entries to hard errors, so a fix
+that removes a finding must delete its suppression in the same change;
+the default run skips the shape audit and retrace check so the editor
+loop stays sub-second and jax-import-free (``--shape-audit`` /
+``--retrace`` force them back on individually).
 """
 
 from __future__ import annotations
@@ -32,6 +33,10 @@ def main(argv=None) -> int:
     ap.add_argument("--shape-audit", action="store_true",
                     help="run the eval_shape audit without the rest of "
                          "the --ci strictness")
+    ap.add_argument("--retrace", action="store_true",
+                    help="run the device-side retrace-budget check "
+                         "(analysis/retrace.py) without the rest of "
+                         "the --ci strictness")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="allowlist file (default: "
                          "blance_tpu/analysis/baseline.toml)")
@@ -42,7 +47,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     shape = args.ci or args.shape_audit
-    if shape:
+    retrace = args.ci or args.retrace
+    if shape or retrace:
         # The sharded contracts want a multi-device mesh; force 8 virtual
         # CPU devices BEFORE jax first imports (same trick as
         # tests/conftest.py).  No-op when jax is already in.
@@ -59,6 +65,7 @@ def main(argv=None) -> int:
         paths=args.paths or None,
         baseline_path=("/dev/null" if args.no_baseline else args.baseline),
         shape_audit=shape,
+        retrace=retrace,
     )
 
     # Stale pins are warnings in the editor loop but HARD ERRORS under
@@ -77,6 +84,7 @@ def main(argv=None) -> int:
             "unused_baseline": [e.render() for e in result.unused_baseline],
             "checked_files": result.checked_files,
             "shape_entries": result.shape_entries,
+            "retrace_entries": result.retrace_entries,
             "errors": result.errors,
             "pass": not failed,
         }, indent=2))
@@ -93,6 +101,7 @@ def main(argv=None) -> int:
         n_base = len(result.baselined)
         print(f"blance_tpu.analysis: {result.checked_files} files, "
               f"{result.shape_entries} shape contracts, "
+              f"{result.retrace_entries} retrace budgets, "
               f"{len(result.new)} new finding(s), {n_base} baselined"
               + (" — FAIL" if failed else " — OK"))
     return 1 if failed else 0

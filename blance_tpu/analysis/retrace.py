@@ -1,0 +1,216 @@
+"""Retrace budgets: the jit-cache contract as a declarative CI table.
+
+The PR-2 shape-bucketing guarantee — repeated solves against drifting
+cluster sizes hit the jit cache instead of recompiling — was previously
+enforced only by tests/conftest.py's per-MODULE recompile budgets.  This
+module promotes it to a per-ENTRY-POINT contract: ``RETRACE_BUDGETS``
+declares, for one canonical CPU workload (cold solve, warm repair,
+bucketed plan, fleet cold+warm batch, sharded dispatch), the maximum
+number of XLA compilations each owning entry point may trigger.  The
+workload runs under :class:`blance_tpu.obs.device.CompileMonitor` with
+the dispatch sites' :func:`~blance_tpu.obs.device.entry` attribution,
+so the count per entry is exact — and a change that makes a solver
+entry point retrace per call (a static becoming traced, a new dynamic
+shape, a cache key that stopped matching) fails ``python -m
+blance_tpu.analysis --ci`` with the entry named, instead of surfacing
+as an unexplained slowdown three PRs later.
+
+Budgets are ceilings for the workload run STANDALONE in a cold process;
+a warm process (the full --ci run, the device-obs CLI) compiles
+strictly less.  Recalibrate by running ``python -m
+blance_tpu.obs.device_check --check`` and reading the per-entry counts it
+prints on failure, then update the table — the same workflow as the
+conftest fixture's ``BLANCE_RECOMPILE_CALIBRATE=1``.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only
+    from . import Finding
+
+__all__ = ["RETRACE_BUDGETS", "run_retrace_check"]
+
+# Per-entry compile ceilings for run_retrace_check()'s workload,
+# calibrated standalone on jax 0.4.37 / CPU (8 virtual devices) with
+# ~50% headroom for jax-internal helper jits.  "other" absorbs eager-op
+# and jax-internal programs that fire outside any dispatch site —
+# deliberately generous, since its population varies across jax patch
+# versions; the solver entries are the contract.
+RETRACE_BUDGETS: dict[str, int] = {
+    # Calibrated: 1 compile each (the workload dispatches each entry 4x
+    # at one shape, so the jit cache absorbs calls 2..4; a per-call
+    # retrace quadruples the count and blows the +1 headroom).
+    "solve_dense.cold": 2,
+    "solve_dense.carry": 2,
+    "solve_dense.warm": 2,
+    "solve_dense.bucketed": 2,
+    "fleet.cold": 3,
+    "fleet.warm": 3,
+    # The shard_map dispatch legitimately compiles many sub-programs
+    # (calibrated 18 on the 8-virtual-device host, both dispatches);
+    # a per-dispatch retrace doubles it.
+    "sharded.cold": 26,
+    # jax-internal eager helper jits (asarray converts, carry scatters);
+    # population varies across jax patch versions, so generous.
+    "other": 48,
+}
+
+
+def _workload() -> None:
+    """The canonical retrace workload: every budgeted entry point
+    dispatched at least twice per shape, so a per-call retrace doubles
+    its count and blows the budget.  Small shapes, CPU-friendly,
+    deterministic."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.types import PlanOptions
+    from ..plan.fleet import TenantProblem, solve_fleet
+    from ..plan.tensor import (
+        carry_from_assignment,
+        solve_dense_converged,
+        solve_dense_warm,
+    )
+
+    P, N, S, R = 48, 8, 2, 1
+    rng = np.random.default_rng(7)
+    prev = np.full((P, S, R), -1, np.int32)
+    prev[:, 0, 0] = rng.integers(0, N, P)
+    prev[:, 1, 0] = (prev[:, 0, 0] + 1 + rng.integers(0, N - 1, P)) % N
+    pw = np.ones(P, np.float32)
+    nw = np.ones(N, np.float32)
+    valid = np.ones(N, bool)
+    stick = np.full((P, S), 1.5, np.float32)
+    gids = np.stack([np.arange(N, dtype=np.int32),
+                     np.arange(N, dtype=np.int32) // 4,
+                     np.zeros(N, np.int32)])
+    gv = np.ones((3, N), bool)
+    constraints = (1, 1)
+    rules = ((), ((2, 1),))
+    dev = [jnp.asarray(a)
+           for a in (prev, pw, nw, valid, stick, gids, gv)]
+
+    # solve_dense.cold — four dispatches of one shape: every call after
+    # the first must ride the jit cache, so a per-call retrace lands at
+    # 4x the budgeted count, far past the +1 headroom.
+    out = solve_dense_converged(*dev, constraints, rules, record=False)
+    for _ in range(3):
+        solve_dense_converged(*dev, constraints, rules, record=False)
+
+    # solve_dense.carry + solve_dense.warm — seed a carry off the cold
+    # fixpoint, repair a 1-partition delta, twice.  The carry is rebuilt
+    # per attempt (it is consumed either way, by contract).
+    dirty = np.zeros(P, bool)
+    dirty[0] = True
+    cur = out
+    for _ in range(4):
+        carry = carry_from_assignment(cur, dev[1], dev[2])
+        res, _next_carry = solve_dense_warm(
+            cur, *dev[1:], constraints, rules, dirty=dirty, carry=carry,
+            record=False)
+        if res is not None:
+            cur = jnp.asarray(res)
+    cfix = carry_from_assignment(cur, dev[1], dev[2])
+    for _ in range(4):
+        solve_dense_converged(cur, *dev[1:], constraints, rules,
+                              record=False, carry_used=cfix.used)
+
+    # solve_dense.bucketed — the pure-path entry with shape bucketing:
+    # two cluster sizes inside one bucket must share one program.
+    from .. import Partition, model
+    from ..core.types import HierarchyRule
+    from ..plan.tensor import plan_next_map_tpu
+
+    m = model(primary=(0, 1), replica=(1, 1))
+    for n_real in (17, 18, 17, 18):  # one shared bucket, two real sizes
+        nodes = [f"n{i:03d}" for i in range(n_real)]
+        hier = {n: f"r{i // 4}" for i, n in enumerate(nodes)}
+        hier.update({f"r{i}": "z0" for i in range((n_real + 3) // 4)})
+        opts = PlanOptions(shape_bucketing=True, node_hierarchy=hier,
+                           hierarchy_rules={"replica": [HierarchyRule(2, 1)]})
+        pmap = {str(i): Partition(str(i), {
+            "primary": [nodes[i % n_real]],
+            "replica": [nodes[(i + 1) % n_real]]}) for i in range(24)}
+        plan_next_map_tpu(pmap, pmap, nodes, [], [], m, opts)
+
+    # fleet.cold + fleet.warm — two dispatches per mode, one class.
+    def tenant(i, carry=None, dirty=None):
+        t_rng = np.random.default_rng(100 + i)
+        t_prev = np.full((P, S, R), -1, np.int32)
+        t_prev[:, 0, 0] = t_rng.integers(0, N, P)
+        t_prev[:, 1, 0] = (t_prev[:, 0, 0] + 1
+                           + t_rng.integers(0, N - 1, P)) % N
+        return TenantProblem(
+            key=f"t{i}", prev=t_prev, partition_weights=pw,
+            node_weights=nw, valid_node=valid, stickiness=stick,
+            gids=gids, gid_valid=gv, constraints=constraints,
+            rules=rules, carry=carry, dirty=dirty)
+
+    cold = [tenant(i) for i in range(3)]
+    res1 = solve_fleet(cold, record=False)
+    for _ in range(3):
+        solve_fleet(cold, record=False)
+    warm = [TenantProblem(
+        key=r.key, prev=r.assign, partition_weights=pw, node_weights=nw,
+        valid_node=valid, stickiness=stick, gids=gids, gid_valid=gv,
+        constraints=constraints, rules=rules, carry=r.carry, dirty=dirty)
+        for r in res1]
+    for _ in range(4):
+        res_w = solve_fleet(warm, record=False)
+        warm = [TenantProblem(
+            key=r.key, prev=r.assign, partition_weights=pw,
+            node_weights=nw, valid_node=valid, stickiness=stick,
+            gids=gids, gid_valid=gv, constraints=constraints,
+            rules=rules, carry=r.carry, dirty=dirty) for r in res_w]
+
+    # sharded.cold — a tiny 2-shard mesh dispatch, twice (skipped on a
+    # single-device host; the budget is then trivially met).
+    if len(jax.devices()) >= 2:
+        from ..parallel.sharded import make_mesh, solve_dense_sharded
+
+        mesh = make_mesh(2)
+        for _ in range(2):
+            solve_dense_sharded(mesh, prev, pw, nw, valid, stick, gids,
+                                gv, constraints, rules)
+
+
+def run_retrace_check() -> tuple[list["Finding"], int]:
+    """Run the workload under a counting monitor; one Finding per entry
+    over budget (DEV001) or compiled-but-unbudgeted (DEV002).  Returns
+    (findings, table size)."""
+    from ..obs.device import CompileMonitor
+    from . import Finding
+
+    with CompileMonitor(emit=False) as mon:
+        _workload()
+    findings: list[Finding] = []
+    counts = dict(mon.by_entry)
+    path = "blance_tpu/analysis/retrace.py"
+    for ent, count in sorted(counts.items()):
+        if ent.endswith("+aot"):
+            # Cost-analysis AOT compiles (obs/device.maybe_publish_cost)
+            # are observation overhead, not retraces: with the
+            # observatory's cost analysis armed during the check (the
+            # device-obs CLI), they must not eat the live budgets.
+            continue
+        budget = RETRACE_BUDGETS.get(ent)
+        if budget is None:
+            findings.append(Finding(
+                rule="DEV002", path=path, line=1, symbol=ent,
+                message=f"entry point {ent!r} compiled {count}x during "
+                        f"the retrace workload but has no budget in "
+                        f"RETRACE_BUDGETS — add one (docs/"
+                        f"STATIC_ANALYSIS.md, 'Retrace budgets')"))
+        elif count > budget:
+            findings.append(Finding(
+                rule="DEV001", path=path, line=1, symbol=ent,
+                message=f"entry point {ent!r} triggered {count} XLA "
+                        f"compilations, over its budget of {budget}: a "
+                        f"solver entry point is retracing more than the "
+                        f"shape-bucketing/static-args contract allows "
+                        f"(per-fn: {dict(sorted(mon.by_fn.items()))})"))
+    return findings, len(RETRACE_BUDGETS)

@@ -36,9 +36,18 @@ rebalance; ``costmodel.CostModel`` learns per-(node, op) EWMA move
 costs from the move-lifecycle spans and persists them as JSON for the
 critical-path scheduler.
 
+The DEVICE side has its own observatory (``device``, opt-in via
+``device.enable()``): XLA compile accounting attributed per owning
+entry point, AOT cost/memory gauges per (entry, bucket-shape), and
+in-graph sweep-level convergence traces; ``tracectx`` adds
+end-to-end request tracing (deterministic ``TraceContext`` ids +
+``RequestTimeline`` latency decomposition, used by
+``plan.service.PlanService``).
+
 See docs/OBSERVABILITY.md for the architecture tour.
 """
 
+from . import device
 from .chrome import ChromeTraceSink, trace, write_chrome_trace
 from .costmodel import CostModel
 from .expo import (
@@ -62,8 +71,23 @@ from .recorder import (
 )
 from .sinks import InMemorySink, JsonlSink, span_to_dict
 from .slo import MoveObserver, SloSummary, SloTracker
+from .tracectx import (
+    SEGMENTS,
+    RequestTimeline,
+    TraceContext,
+    TraceIdSource,
+    current_trace,
+    use_trace,
+)
 
 __all__ = [
+    "device",
+    "TraceContext",
+    "TraceIdSource",
+    "RequestTimeline",
+    "SEGMENTS",
+    "current_trace",
+    "use_trace",
     "Recorder",
     "Span",
     "DEFAULT_BUCKETS",

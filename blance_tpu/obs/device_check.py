@@ -1,0 +1,17 @@
+"""``python -m blance_tpu.obs.device_check`` — the device-obs CI gate.
+
+A thin delegate over :func:`blance_tpu.obs.device.main` (same flags:
+``--check``, ``--trace-out``).  The package ``__init__`` imports
+``obs.device`` eagerly, so ``python -m blance_tpu.obs.device`` would
+execute the module a SECOND time under runpy (the 'found in
+sys.modules' RuntimeWarning) with its own copy of the observatory
+state; this shim is imported by nothing, so running it executes once
+and arms the canonical instance — the same pattern as
+``obs/__main__.py``."""
+
+import sys
+
+from .device import main
+
+if __name__ == "__main__":
+    sys.exit(main())

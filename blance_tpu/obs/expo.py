@@ -134,16 +134,12 @@ class MetricsRegistry:
             counters = list(recorder.counters)
             gauges = list(recorder.gauges)
             hists = list(recorder._hist_stats)
-        for name in counters:
-            if not self.declared(name, "counter"):
-                out.append(f"counter:{name}")
-        for key in gauges:
-            base = key.split("{", 1)[0]
-            if not self.declared(base, "gauge"):
-                out.append(f"gauge:{base}")
-        for name in hists:
-            if not self.declared(name, "histogram"):
-                out.append(f"histogram:{name}")
+        for kind, keys in (("counter", counters), ("gauge", gauges),
+                           ("histogram", hists)):
+            for key in keys:
+                base = key.split("{", 1)[0]
+                if not self.declared(base, kind):
+                    out.append(f"{kind}:{base}")
         return sorted(set(out))
 
 
@@ -255,6 +251,33 @@ def default_registry() -> MetricsRegistry:
                "seconds from plan-service submit to resolved result"),
         Metric("fleet.dispatch_s", "histogram",
                "wall-clock seconds per fleet batch device dispatch"),
+        Metric("fleet.request_segment_s", "histogram",
+               "per-request latency decomposition (labeled by segment: "
+               "admission/coalesce/executor_queue/device/resolve; the "
+               "segments tile submit-to-resolve exactly)"),
+        # -- device (obs/device.py; all emitted only while the device
+        # observatory is enabled) ---------------------------------------------
+        Metric("device.compiles", "counter",
+               "XLA compilations, labeled by owning entry point "
+               "(solve_dense cold/carry/warm/bucketed, fleet batch "
+               "classes, sharded dispatch, other)"),
+        Metric("device.compile_s", "histogram",
+               "seconds per XLA backend compilation (labeled by entry)"),
+        Metric("device.cost_analyses", "counter",
+               "AOT cost/memory analyses published (one per entry x "
+               "bucket-shape, memoized)"),
+        Metric("device.flops", "gauge",
+               "XLA cost-analysis FLOPs per dispatch of the compiled "
+               "program (labeled entry + bucket-shape klass)"),
+        Metric("device.hbm_bytes", "gauge",
+               "XLA cost-analysis bytes accessed per dispatch (labeled "
+               "entry + klass)"),
+        Metric("device.peak_alloc_bytes", "gauge",
+               "XLA memory-analysis argument+output+temp bytes for the "
+               "compiled program (labeled entry + klass)"),
+        Metric("device.sweep_accept_frac", "histogram",
+               "per-sweep accepted-bid fraction of the converged solve "
+               "(also a Chrome counter track under the solve span)"),
     ]
     metrics.extend(
         Metric("orchestrate." + name, "counter",
@@ -292,13 +315,41 @@ def render_prometheus(recorder: Optional[Recorder] = None,
     with rec._lock:  # the Recorder is counted from threads too; copying
         counters = dict(rec.counters)  # an unlocked dict mid-insert can
         gauges = dict(rec.gauges)  # raise 'changed size during iteration'
+        hist_keys = list(rec._hist_stats)
     lines: list[str] = []
+
+    def _render_hist(key: str, pname: str, labels: str) -> None:
+        """One histogram series (base or labeled).  ``labels`` is the
+        inner label list ('' for the base series); the le label composes
+        with it inside one brace set, per the exposition format."""
+        hb = rec.histogram_buckets(key)
+        sep = "," if labels else ""
+        suffix = f"{{{labels}}}" if labels else ""
+        if hb is None:
+            lines.append(f'{pname}_bucket{{{labels}{sep}le="+Inf"}} 0')
+            lines.append(f"{pname}_sum{suffix} 0")
+            lines.append(f"{pname}_count{suffix} 0")
+            return
+        bounds, cum, count, total = hb
+        for b, c in zip(bounds, cum):
+            lines.append(
+                f'{pname}_bucket{{{labels}{sep}le="{_fmt(b)}"}} {c}')
+        lines.append(f'{pname}_bucket{{{labels}{sep}le="+Inf"}} {cum[-1]}')
+        lines.append(f"{pname}_sum{suffix} {_fmt(total)}")
+        lines.append(f"{pname}_count{suffix} {count}")
+
     for m in reg.metrics():
         pname = reg.prom_name(m)
         lines.append(f"# HELP {pname} {m.help}")
         lines.append(f"# TYPE {pname} {m.kind}")
         if m.kind == "counter":
-            lines.append(f"{pname} {_fmt(counters.get(m.name, 0))}")
+            labeled = sorted(k for k in counters
+                             if k.startswith(m.name + "{"))
+            if m.name in counters or not labeled:
+                lines.append(f"{pname} {_fmt(counters.get(m.name, 0))}")
+            for key in labeled:
+                lines.append(f"{pname}{key[len(m.name):]} "
+                             f"{_fmt(counters[key])}")
         elif m.kind == "gauge":
             labeled = sorted(k for k in gauges
                              if k.startswith(m.name + "{"))
@@ -310,18 +361,12 @@ def render_prometheus(recorder: Optional[Recorder] = None,
             if m.name not in gauges and not labeled:
                 lines.append(f"{pname} 0")
         else:  # histogram
-            hb = rec.histogram_buckets(m.name)
-            if hb is None:
-                lines.append(f'{pname}_bucket{{le="+Inf"}} 0')
-                lines.append(f"{pname}_sum 0")
-                lines.append(f"{pname}_count 0")
-            else:
-                bounds, cum, count, total = hb
-                for b, c in zip(bounds, cum):
-                    lines.append(f'{pname}_bucket{{le="{_fmt(b)}"}} {c}')
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {cum[-1]}')
-                lines.append(f"{pname}_sum {_fmt(total)}")
-                lines.append(f"{pname}_count {count}")
+            labeled = sorted(k for k in hist_keys
+                             if k.startswith(m.name + "{"))
+            if m.name in hist_keys or not labeled:
+                _render_hist(m.name, pname, "")
+            for key in labeled:
+                _render_hist(key, pname, key[len(m.name) + 1:-1])
     return "\n".join(lines) + "\n"
 
 
@@ -383,6 +428,8 @@ class MetricsServer:
         self._server: Optional[asyncio.Server] = None
         self._cached: Optional[str] = None
         self._cached_at: Optional[float] = None
+        self._started_at: Optional[float] = None
+        self._snapshots = 0
 
     # -- snapshotting --------------------------------------------------------
 
@@ -403,13 +450,43 @@ class MetricsServer:
                 now - self._cached_at >= self._min_interval_s:
             self._cached = self.render()
             self._cached_at = now
+            self._snapshots += 1
         return self._cached
+
+    def _healthz(self) -> tuple[str, bytes]:
+        """Liveness + freshness: 200 with uptime/snapshot-age JSON once
+        a snapshot exists, 503 before the first one — so a scraper (and
+        the CI obs-smoke) can tell 'up and serving fresh aggregates'
+        from 'up but you would get a stale or empty cache'."""
+        import json
+
+        rec = self._recorder if self._recorder is not None \
+            else get_recorder()
+        now = rec.now()
+        if self._cached_at is None:
+            payload = {"status": "no-snapshot",
+                       "uptime_s": (now - self._started_at
+                                    if self._started_at is not None
+                                    else None)}
+            return "503 Service Unavailable", \
+                (json.dumps(payload) + "\n").encode()
+        payload = {
+            "status": "ok",
+            "uptime_s": (now - self._started_at
+                         if self._started_at is not None else None),
+            "snapshot_age_s": now - self._cached_at,
+            "snapshots": self._snapshots,
+        }
+        return "200 OK", (json.dumps(payload) + "\n").encode()
 
     # -- server lifecycle ----------------------------------------------------
 
     async def start(self) -> None:
         if self._server is not None:
             raise RuntimeError("MetricsServer already started")
+        rec = self._recorder if self._recorder is not None \
+            else get_recorder()
+        self._started_at = rec.now()
         self._server = await asyncio.start_server(
             self._handle, self._host, self._requested_port)
 
@@ -436,15 +513,19 @@ class MetricsServer:
                     break
             parts = request.split()
             path = parts[1].decode("latin-1") if len(parts) >= 2 else ""
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
             if parts and parts[0] != b"GET":
                 status, body = "405 Method Not Allowed", b"method not allowed\n"
             elif path in ("/metrics", "/"):
                 status, body = "200 OK", self._snapshot().encode()
+            elif path == "/healthz":
+                status, body = self._healthz()
+                ctype = "application/json; charset=utf-8"
             else:
                 status, body = "404 Not Found", b"not found\n"
             writer.write(
                 f"HTTP/1.1 {status}\r\n"
-                f"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + body)
             await writer.drain()
@@ -524,6 +605,14 @@ async def _smoke_async(fail_rate: float = 0.3, seed: int = 7) -> int:
                                min_interval_s=0.01)
         await server.start()
         try:
+            # /healthz before ANY metrics scrape: no snapshot exists yet,
+            # so a healthy-but-stale server must answer 503, not 200 —
+            # that is the distinction real scrapers key alerts on.
+            try:
+                await scrape("127.0.0.1", server.port, path="/healthz")
+                health_pre = "200"
+            except RuntimeError as e:
+                health_pre = "503" if " 503 " in f" {e} " else str(e)
             loop = asyncio.get_running_loop()
             # Decommission one live node AND add the dead one: the
             # decommission forces real (retried-through-the-flakes)
@@ -547,6 +636,8 @@ async def _smoke_async(fail_rate: float = 0.3, seed: int = 7) -> int:
             text2 = await scrape("127.0.0.1", server.port)
             result = await run
             text3 = await scrape("127.0.0.1", server.port)
+            health = await scrape("127.0.0.1", server.port,
+                                  path="/healthz")
         finally:
             await server.stop()
 
@@ -583,6 +674,17 @@ async def _smoke_async(fail_rate: float = 0.3, seed: int = 7) -> int:
           "executed-move gauge advanced")
     check(s3["blance_orchestrate_move_failures_total"] > 0,
           "chaos actually injected failures")
+    check(health_pre == "503",
+          f"/healthz is 503 before the first snapshot (got {health_pre})")
+    import json as _json
+
+    try:
+        hz = _json.loads(health)
+    except ValueError:
+        hz = {}
+    check(hz.get("status") == "ok" and hz.get("snapshot_age_s", -1) >= 0
+          and hz.get("uptime_s", -1) >= 0,
+          f"/healthz serves ok + uptime/snapshot-age JSON (got {health!r})")
     if failures:
         print(f"obs-smoke: FAIL ({len(failures)} checks)", file=sys.stderr)
         return 1

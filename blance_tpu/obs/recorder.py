@@ -67,7 +67,18 @@ __all__ = [
     "use_recorder",
     "phase_span",
     "percentile",
+    "escape_label_value",
 ]
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus label-value escaping (backslash, double quote,
+    newline) — THE one spelling every labeled-metric emitter uses
+    (obs/slo.py node labels, obs/device.py entry/klass labels), so the
+    escaping rules cannot drift between emitters.  Arbitrary caller
+    strings must not invalidate the whole scrape."""
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
 
 
 @dataclass
@@ -275,6 +286,21 @@ class Recorder:
         the exposition endpoint serves them)."""
         with self._lock:
             self.gauges[name] = float(value)
+
+    def sample(self, name: str, value: float,
+               t: Optional[float] = None) -> None:
+        """One time-stamped series point: recorded as a histogram
+        observation (aggregates) AND forwarded to counter-capable sinks
+        as a Chrome counter-track sample at time ``t`` (default: now).
+        This is how a value-over-time series that is neither monotone
+        (counter) nor last-value (gauge) — e.g. the per-sweep
+        accepted-bid fraction — gets a track on the span timeline."""
+        self.observe(name, value)
+        notify = self._counter_sinks
+        if notify:
+            tt = self._clock() if t is None else t
+            for sink in notify:
+                sink.counter(name, float(value), tt)
 
     def set_hist_bounds(self, name: str, bounds: tuple[float, ...]) -> None:
         """Override the bucket upper bounds for one series.  Must happen

@@ -42,17 +42,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, \
     Sequence
 
-from .recorder import Recorder, get_recorder
+from .recorder import Recorder, escape_label_value, get_recorder
 
 __all__ = ["MoveObserver", "SloSummary", "SloTracker"]
 
-
-def _escape_label(value: str) -> str:
-    """Prometheus label-value escaping (backslash, double quote,
-    newline): node names are arbitrary caller strings, and one bad
-    character must not invalidate the whole scrape."""
-    return value.replace("\\", "\\\\").replace('"', '\\"') \
-        .replace("\n", "\\n")
+# Kept as the module-local spelling; the one implementation lives in
+# obs/recorder.py so it cannot drift from obs/device.py's labels.
+_escape_label = escape_label_value
 
 
 class MoveObserver(Protocol):

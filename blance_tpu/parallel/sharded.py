@@ -40,6 +40,7 @@ from ..plan.tensor import (
     carry_from_assignment,
     solve_dense_converged,
 )
+from ..obs import device as _obs_device
 from ..obs import get_recorder
 
 # shard_map moved across JAX versions (jax.experimental.shard_map ->
@@ -314,7 +315,8 @@ def solve_dense_sharded(
                                  shard, rep),
                        out_specs=(shard, rep, rep))
         fn_w = _build_checked(sm_w, checked_ok)
-        with rec.span("plan.solve.attempt", warm=True, sharded=True):
+        with rec.span("plan.solve.attempt", warm=True, sharded=True), \
+                _obs_device.entry("sharded.warm"):
             # transfer_guard allowlist: dispatching a fresh shard_map
             # executable uploads its jaxpr closure constants as
             # replicated buffers — an IMPLICIT transfer by jax's
@@ -360,7 +362,9 @@ def solve_dense_sharded(
                  out_specs=shard)
     fn = _build_checked(sm, checked_ok)
     # Same dispatch-time constant-upload exemption as the warm path.
-    with jax.transfer_guard("allow"):
+    # The observatory attribution is first-wins, so the body's inner
+    # solve_dense_converged labels stay subordinate to "sharded.cold".
+    with jax.transfer_guard("allow"), _obs_device.entry("sharded.cold"):
         out = fn(*dev_args)
     assign = np.asarray(out)[:p_orig]
     if return_carry:

@@ -61,6 +61,7 @@ from ..core.encode import (
     pad_to,
     stack_problem_arrays,
 )
+from ..obs import device as _device
 from ..obs import get_recorder
 from .carry import capacity_shrank, effective_dirty
 from .tensor import (
@@ -418,6 +419,7 @@ def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
     instead of recompiling per size."""
     b_real = fn_args[0].shape[0]
     b_target = bucket_size(b_real)
+    ent = "fleet.warm" if warm else "fleet.cold"
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         b_target += (-b_target) % n_dev
@@ -431,22 +433,26 @@ def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
         # in one placement (jnp.asarray first would commit every operand
         # to the default device and then reshard — double transfer).
         dev_args = [jax.device_put(a, spec) for a in fn_args]
+        _device.maybe_publish_cost(
+            ent, f"{k.p}x{k.n}xB{b_padded}", fn, *dev_args)
         # Dispatch-time jaxpr-constant uploads are implicit transfers by
         # jax's classification but intrinsic to compilation — the same
         # scoped allow parallel/sharded.py documents.
-        with jax.transfer_guard("allow"):
+        with jax.transfer_guard("allow"), _device.entry(ent):
             outs = fn(*dev_args)
     else:
         fn_args, b_padded = _pad_batch(fn_args, b_target)
         dev_args = [jnp.asarray(a) for a in fn_args]
-        if warm:
-            outs = _fleet_warm_batch(
-                *dev_args, constraints=k.constraints, rules=k.rules,
-                fused_score=fused_score)
-        else:
-            outs = _fleet_cold_batch(
-                *dev_args, constraints=k.constraints, rules=k.rules,
-                max_iterations=max_iterations, fused_score=fused_score)
+        batch_fn = _fleet_warm_batch if warm else _fleet_cold_batch
+        statics = dict(constraints=k.constraints, rules=k.rules,
+                       fused_score=fused_score)
+        if not warm:
+            statics["max_iterations"] = max_iterations
+        _device.maybe_publish_cost(
+            ent, f"{k.p}x{k.n}xB{b_padded}", batch_fn, *dev_args,
+            **statics)
+        with _device.entry(ent):
+            outs = batch_fn(*dev_args, **statics)
     if record:
         rec.observe("fleet.batch_tenants", float(b_real))
         rec.observe("fleet.batch_occupancy",
@@ -476,6 +482,21 @@ def _real_carry(assign: np.ndarray, used_padded: np.ndarray,
     return SolveCarry(prices=used.sum(axis=0), assign=assign, used=used)
 
 
+def _trace_attrs(trace_ids: Optional[dict],
+                 keys: Sequence[str]) -> dict:
+    """Span attrs carrying the batch members' trace ids (capped: a
+    thousand-tenant batch must not serialize a novel per span)."""
+    if not trace_ids:
+        return {}
+    ids = [str(trace_ids[k]) for k in keys if k in trace_ids]
+    if not ids:
+        return {}
+    shown = ",".join(ids[:16])
+    if len(ids) > 16:
+        shown += f",+{len(ids) - 16}"
+    return {"trace_ids": shown}
+
+
 def solve_fleet(
     problems: Sequence[TenantProblem],
     *,
@@ -484,6 +505,7 @@ def solve_fleet(
     fused_score: Optional[str] = None,
     record: bool = True,
     recorder=None,
+    trace_ids: Optional[dict] = None,
 ) -> list[FleetResult]:
     """Solve every tenant, batched by bucket class: one device dispatch
     per (class, warm/cold) instead of one per tenant.
@@ -508,6 +530,10 @@ def solve_fleet(
     carry/sweep counters mirror the single-problem spellings.
     ``recorder`` overrides the process recorder (the plan service
     passes its own so executor-thread solves report to the right one).
+    ``trace_ids`` (tenant key → trace id, the plan service's
+    :class:`obs.tracectx.TraceContext` ids) rides into each
+    ``fleet.dispatch`` span's attrs so a request's device dispatch is
+    findable from its trace id in Perfetto and the JSONL sink.
     """
     rec = recorder if recorder is not None else get_recorder()
     results: dict[int, FleetResult] = {}
@@ -570,7 +596,10 @@ def solve_fleet(
             t0 = rec.now()
             with rec.span("fleet.dispatch", warm=True,
                           tenants=len(warm_idx),
-                          klass=f"{k.p}x{k.n}"):
+                          klass=f"{k.p}x{k.n}",
+                          **_trace_attrs(trace_ids,
+                                         [tenants[i].key
+                                          for i in warm_idx])):
                 out_b, used_b, ok_b = _dispatch(
                     stacked, mesh, True, k, max_iterations, mode, rec,
                     record)
@@ -613,7 +642,10 @@ def solve_fleet(
             t0 = rec.now()
             with rec.span("fleet.dispatch", warm=False,
                           tenants=len(cold_idx),
-                          klass=f"{k.p}x{k.n}"):
+                          klass=f"{k.p}x{k.n}",
+                          **_trace_attrs(trace_ids,
+                                         [tenants[i].key
+                                          for i in cold_idx])):
                 out_b, sweeps_b, used_b = _dispatch(
                     stacked, mesh, False, k, max_iterations, mode, rec,
                     record)

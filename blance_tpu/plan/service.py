@@ -51,6 +51,7 @@ from functools import partial
 from typing import TYPE_CHECKING, Optional
 
 from ..obs import get_recorder
+from ..obs.tracectx import RequestTimeline, TraceContext, TraceIdSource
 from .carry import CarryCache
 from .fleet import FleetResult, TenantProblem, solve_fleet, validate_tenant
 
@@ -71,6 +72,10 @@ class _Request:
     problem: TenantProblem
     future: "asyncio.Future[FleetResult]"
     t_submit: float
+    # End-to-end trace: minted at submit, marks appended as the request
+    # crosses each stage, recorded (spans + segment histograms) at
+    # resolution.  docs/OBSERVABILITY.md "Request decomposition".
+    timeline: Optional[RequestTimeline] = None
 
 
 _STOP = object()  # queue sentinel: drain and exit
@@ -107,6 +112,7 @@ class PlanService:
         carry_entries: Optional[int] = 16384,
         max_iterations: int = 10,
         recorder: Optional["Recorder"] = None,
+        inline_solve: bool = False,
     ) -> None:
         if max_pending <= 0 or max_batch <= 0:
             raise ValueError("max_pending and max_batch must be positive")
@@ -114,7 +120,16 @@ class PlanService:
         self.max_batch = int(max_batch)
         self.mesh = mesh
         self.max_iterations = int(max_iterations)
+        # inline_solve runs the fleet batch on the dispatcher coroutine
+        # instead of a worker thread: admission no longer pipelines
+        # against device compute (don't use it in production), but the
+        # service becomes loop-only — which is what lets the PR-5
+        # DeterministicLoop drive it, making the whole request-tracing
+        # plane (segments, trace ids, histograms) a pure function of
+        # the seeded schedule.
+        self.inline_solve = bool(inline_solve)
         self._rec = recorder if recorder is not None else get_recorder()
+        self._trace_ids = TraceIdSource()
         self.carry_cache = carry_cache if carry_cache is not None \
             else CarryCache(max_bytes=carry_bytes,
                             max_entries=carry_entries)
@@ -132,8 +147,9 @@ class PlanService:
             raise PlanServiceClosed("PlanService is stopped")
         if self._task is not None:
             return
-        self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="plan-fleet")
+        if not self.inline_solve:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="plan-fleet")
         task = asyncio.get_running_loop().create_task(
             self._run(), name="PlanService._run")
         task.add_done_callback(self._on_run_done)
@@ -212,12 +228,21 @@ class PlanService:
 
     # -- the app-facing surface ----------------------------------------------
 
-    async def submit(self, problem: TenantProblem) -> FleetResult:
+    async def submit(self, problem: TenantProblem,
+                     ctx: Optional[TraceContext] = None) -> FleetResult:
         """Plan one tenant; resolves when its batch lands.
 
         Awaiting queue space is the backpressure contract; the result
         is bit-identical to solving the tenant alone on the single-
-        problem path (plan/fleet.py's guarantee)."""
+        problem path (plan/fleet.py's guarantee).
+
+        A :class:`TraceContext` is minted here (or passed in by a
+        caller propagating a wider trace) and rides the request end to
+        end: at resolution the request's latency is recorded as one
+        ``fleet.request`` span, one span per lifecycle segment, and
+        ``fleet.request_segment_s{segment=...}`` histogram samples —
+        the segments tile [submit, resolve] exactly, so their sum IS
+        the end-to-end latency."""
         if self._closed or self._task is None:
             raise PlanServiceClosed(
                 "PlanService is not running (call start(), not stopped)")
@@ -225,7 +250,10 @@ class PlanService:
         rec.count("fleet.requests")
         fut: "asyncio.Future[FleetResult]" = \
             asyncio.get_running_loop().create_future()
-        await self._queue.put(_Request(problem, fut, rec.now()))
+        t_submit = rec.now()
+        timeline = RequestTimeline(
+            ctx if ctx is not None else self._trace_ids.mint(), t_submit)
+        await self._queue.put(_Request(problem, fut, t_submit, timeline))
         if self._closed:
             # The service stopped (or its dispatcher died) while this
             # submit was blocked on a full queue: the crash-path drain
@@ -262,6 +290,8 @@ class PlanService:
             if nxt is _STOP:
                 return batch, True
             assert isinstance(nxt, _Request)
+            if nxt.timeline is not None:
+                nxt.timeline.mark("admission", self._rec.now())
             batch.append(nxt)
         return batch, False
 
@@ -288,6 +318,21 @@ class PlanService:
         return dataclasses.replace(
             t, carry=carry, dirty=t.dirty | cached_dirty)
 
+    def _solve_batch(self, problems: list[TenantProblem],
+                     trace_ids: dict) -> tuple[
+                         float, float, list[FleetResult]]:
+        """The executor-side (or inline) solve, stamped on the
+        recorder's clock: (t_solve_start, t_solve_end, results).  The
+        stamps are what split a request's ``executor_queue`` segment
+        (batch closed → solver started) from its ``device`` segment."""
+        rec = self._rec
+        t_start = rec.now()
+        results = solve_fleet(
+            problems, mesh=self.mesh,
+            max_iterations=self.max_iterations, recorder=rec,
+            trace_ids=trace_ids)
+        return t_start, rec.now(), results
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         rec = self._rec
@@ -304,6 +349,8 @@ class PlanService:
                     first.future.set_exception(
                         PlanServiceClosed("PlanService stopped"))
                 return
+            if first.timeline is not None:
+                first.timeline.mark("admission", rec.now())
             batch = [first]
             stop_seen = False
             # EVERY admitted request's future resolves inside this try:
@@ -314,8 +361,11 @@ class PlanService:
                 batch, stop_seen = await self._admit_batch(first)
                 rec.set_gauge("fleet.queue_depth",
                               float(self._queue.qsize()))
+                t_batched = rec.now()
                 pairs = []
                 for r in batch:
+                    if r.timeline is not None:
+                        r.timeline.mark("coalesce", t_batched)
                     try:
                         pairs.append(
                             (r, self._with_cached_carry(r.problem)))
@@ -325,12 +375,19 @@ class PlanService:
                         if not r.future.done():
                             r.future.set_exception(e)
                 if pairs:
-                    results = await loop.run_in_executor(
-                        self._executor,
-                        partial(solve_fleet,
-                                [p for _, p in pairs], mesh=self.mesh,
-                                max_iterations=self.max_iterations,
-                                recorder=rec))
+                    trace_ids = {
+                        r.problem.key: r.timeline.ctx.trace_id
+                        for r, _ in pairs if r.timeline is not None}
+                    problems = [p for _, p in pairs]
+                    if self.inline_solve:
+                        t_start, t_end, results = self._solve_batch(
+                            problems, trace_ids)
+                    else:
+                        t_start, t_end, results = \
+                            await loop.run_in_executor(
+                                self._executor,
+                                partial(self._solve_batch, problems,
+                                        trace_ids))
                     for (r, _), res in zip(pairs, results):
                         # Adopt each result as the tenant's new warm
                         # state; the dispatcher is the cache's only
@@ -345,10 +402,17 @@ class PlanService:
                             # carry built from the unmutated plan.
                             self.carry_cache.store(
                                 res.key, res.carry, res.assign.copy())
+                        t_res = rec.now()
                         rec.observe("fleet.admission_latency_s",
-                                    rec.now() - r.t_submit)
+                                    t_res - r.t_submit)
                         if not r.future.done():
                             r.future.set_result(res)
+                        if r.timeline is not None:
+                            r.timeline.mark("executor_queue", t_start)
+                            r.timeline.mark("device", t_end)
+                            r.timeline.mark("resolve", t_res)
+                            r.timeline.record(
+                                rec, tenant=res.key, warm=res.warm)
             except Exception as e:
                 for r in batch:
                     if not r.future.done():

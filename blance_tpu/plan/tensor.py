@@ -35,6 +35,7 @@ stay globally consistent while scores stay local.
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -46,6 +47,7 @@ from jax import lax
 
 from ..core.encode import DenseProblem, decode_assignment, encode_problem
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
+from ..obs import device as _device
 from ..obs import get_recorder, phase_span
 from ..ops.reduce2 import (
     min2_argmin_reference,
@@ -1354,7 +1356,8 @@ def solve_dense(
 
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
                                    "max_iterations", "node_axis",
-                                   "node_shards", "fused_score"))
+                                   "node_shards", "fused_score",
+                                   "trace_sweeps"))
 def _solve_dense_converged_impl(
     prev: jnp.ndarray,
     pweights: jnp.ndarray,
@@ -1372,12 +1375,21 @@ def _solve_dense_converged_impl(
     fused_score: str = "off",
     carry_used: Optional[jnp.ndarray] = None,
     p_real: Optional[jnp.ndarray] = None,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    trace_sweeps: bool = False,
+) -> tuple[jnp.ndarray, ...]:
     """Jitted fixpoint body; returns (assign, sweeps-executed).
 
     ``carry_used`` seeds the FIRST sweep only — like cluster deltas
     (plan.go:49-55), the carry describes the state the loop starts from;
-    later sweeps re-derive their seed from their own input."""
+    later sweeps re-derive their seed from their own input.
+
+    ``trace_sweeps`` (static) additionally accumulates each sweep's
+    accepted-bid fraction — the share of REAL partitions whose
+    assignment that sweep changed — in-graph, returning
+    (assign, sweeps, fracs[max_iterations]) so the device observatory
+    (obs/device.py) can export a convergence track without per-sweep
+    host round-trips.  Off (the default) the trace and outputs are
+    byte-identical to before the flag existed."""
     def solve(x, cu=None):
         return solve_dense(x, pweights, nweights, valid, stickiness,
                            gids, gid_valid, constraints, rules, axis_name,
@@ -1386,19 +1398,55 @@ def _solve_dense_converged_impl(
 
     first = solve(prev, carry_used)
 
-    def cond(carry):
-        out, prev_i, it = carry
+    if not trace_sweeps:
+        def cond(carry):
+            out, prev_i, it = carry
+            changed = jnp.any(out != prev_i)
+            if axis_name:
+                changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
+            return changed & (it < max_iterations)
+
+        def body(carry):
+            out, _prev, it = carry
+            return solve(out), out, it + 1
+
+        out, _, it = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
+        return out, it
+
+    # Traced variant: same fixpoint, plus a [max_iterations] accumulator
+    # of per-sweep changed-row fractions.  The denominator is the REAL
+    # partition count (p_real under bucketing — pad rows are inert and
+    # never change), psum'd across partition shards like every other
+    # global count.
+    if p_real is not None:
+        denom = jnp.maximum(jnp.asarray(p_real, jnp.float32), 1.0)
+    else:
+        denom = jnp.maximum(
+            _psum(jnp.array(prev.shape[0], jnp.float32), axis_name), 1.0)
+
+    def frac(a, b):
+        changed = jnp.any(a != b, axis=(1, 2))
+        total = jnp.sum(changed.astype(jnp.float32))
+        return _psum(total, axis_name) / denom
+
+    fracs0 = jnp.zeros(max_iterations, jnp.float32) \
+        .at[0].set(frac(first, prev))
+
+    def cond_t(carry):
+        out, prev_i, it, _f = carry
         changed = jnp.any(out != prev_i)
         if axis_name:
             changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
         return changed & (it < max_iterations)
 
-    def body(carry):
-        out, _prev, it = carry
-        return solve(out), out, it + 1
+    def body_t(carry):
+        out, _prev, it, f = carry
+        new = solve(out)
+        return new, out, it + 1, f.at[it].set(frac(new, out))
 
-    out, _, it = lax.while_loop(cond, body, (first, prev, jnp.array(1)))
-    return out, it
+    out, _, it, fracs = lax.while_loop(
+        cond_t, body_t, (first, prev, jnp.array(1), fracs0))
+    return out, it, fracs
 
 
 def _record_sweeps(sweeps: object) -> None:
@@ -1548,12 +1596,48 @@ def solve_dense_converged(
     """
     _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
                            constraints, rules)
-    out, sweeps = _solve_dense_converged_impl(
-        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
-        constraints, rules, axis_name, max_iterations, node_axis,
-        node_shards, fused_score, carry_used, p_real)
+    # An enclosing dispatch site's entry scope (the bucketed plan path)
+    # owns BOTH instruments — compile attribution is first-wins anyway,
+    # and the cost gauges must agree with it, or the documented
+    # device.flops{entry="solve_dense.bucketed"} series would never
+    # exist while "cold" silently absorbed bucketed-shape classes.
+    ent = _device.ambient_entry() or (
+        "solve_dense.carry" if carry_used is not None
+        else "solve_dense.cold")
+    # Device observatory (obs/device.py), all opt-in: the sweep trace
+    # compiles a sibling program with the convergence accumulator, and
+    # cost analysis AOT-compiles the dispatched program once per
+    # (entry, shape).  Both are host-side only — under an outer
+    # jit/shard_map trace the args are tracers and everything below is
+    # skipped, so the sharded dispatch keeps owning its own scope.
+    concrete = not isinstance(prev, jax.core.Tracer)
+    want_trace = (record and concrete and
+                  _device.sweep_trace_enabled())
+    if concrete:
+        # Lower the ACTUAL dispatched unit — the converged fixpoint
+        # program, not one solve_dense sweep — so the gauge's unit
+        # ("FLOPs per dispatch") is consistent with the fleet/warm
+        # entries, which also publish their real dispatched programs.
+        _device.maybe_publish_cost(
+            ent, f"{prev.shape[0]}x{nweights.shape[0]}",
+            _solve_dense_converged_impl,
+            prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+            constraints, rules, axis_name, max_iterations, node_axis,
+            node_shards, fused_score, carry_used, p_real)
+    rec = get_recorder()
+    t0 = rec.now()
+    with _device.entry(ent):
+        res = _solve_dense_converged_impl(
+            prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+            constraints, rules, axis_name, max_iterations, node_axis,
+            node_shards, fused_score, carry_used, p_real,
+            trace_sweeps=want_trace)
+    out, sweeps = res[0], res[1]
     if record:
         _record_sweeps(sweeps)
+    if want_trace:
+        _device.record_sweep_trace(rec, t0, rec.now(), int(sweeps),
+                                   np.asarray(res[2]))
     if return_carry:
         return out, carry_from_assignment(out, pweights, nweights)
     return out
@@ -1689,14 +1773,25 @@ def solve_dense_warm(
     if donate is None:
         donate = jax.default_backend() != "cpu"
     impl = _warm_repair_donating if donate else _warm_repair_jit
+    dev_args = (
+        jnp.asarray(prev), jnp.asarray(pweights), jnp.asarray(nweights),
+        jnp.asarray(valid), jnp.asarray(stickiness), jnp.asarray(gids),
+        jnp.asarray(gid_valid), jnp.asarray(dirty_np),
+        jnp.asarray(carry.used))
+    # Cost gauges BEFORE the dispatch: with donation on, the live call
+    # consumes its operand buffers and a later lower() could not touch
+    # them.  Memoized per (entry, shape) — steady state pays nothing.
+    _device.maybe_publish_cost(
+        "solve_dense.warm",
+        f"{dev_args[0].shape[0]}x{dev_args[2].shape[0]}", _warm_repair_jit,
+        *dev_args, constraints=constraints, rules=rules,
+        fused_score=fused_score, p_real=p_real)
     with rec.span("plan.solve.attempt", warm=True,
                   engine={"off": "matrix", "on": "fused",
-                          "interpret": "fused-interpret"}[fused_score]):
+                          "interpret": "fused-interpret"}[fused_score]), \
+            _device.entry("solve_dense.warm"):
         out, new_used, ok = impl(
-            jnp.asarray(prev), jnp.asarray(pweights), jnp.asarray(nweights),
-            jnp.asarray(valid), jnp.asarray(stickiness), jnp.asarray(gids),
-            jnp.asarray(gid_valid), jnp.asarray(dirty_np),
-            jnp.asarray(carry.used), constraints=constraints, rules=rules,
+            *dev_args, constraints=constraints, rules=rules,
             fused_score=fused_score, p_real=p_real)
         accepted = bool(ok)
     if not accepted:
@@ -2240,10 +2335,17 @@ def plan_next_map_tpu(
             pad_problem_arrays(prev_a, pw_a, nw_a, valid_a, stick_a,
                                gids_a, gv_a, solve_p, solve_n)
 
+    # Observatory attribution: the bucketed pure path owns its compiles
+    # as "solve_dense.bucketed" (first-wins, so the inner cold/carry
+    # labels inside solve_dense_converged don't re-claim them); the
+    # unbucketed path lets the inner labels stand.
+    obs_entry = _device.entry("solve_dense.bucketed") \
+        if opts.shape_bucketing else contextlib.nullcontext()
     with phase_span("plan.solve", timer=timer,
                     partitions=problem.P, nodes=problem.N,
                     bucketed_shape=((solve_p, solve_n)
-                                    if opts.shape_bucketing else None)):
+                                    if opts.shape_bucketing else None)), \
+            obs_entry:
         assign, _engine = solve_converged_resilient(
             jnp.asarray(prev_a),
             jnp.asarray(pw_a),

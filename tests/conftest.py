@@ -111,10 +111,18 @@ def no_implicit_transfers():
 #                     transfer-guard state flips busting the eager cache)
 #   test_sharded      total=190 (shard_map bodies log as '<unnamed')
 #   test_sharded_2d   total=171 (shared-process; standalone runs higher)
+#   test_fleet        total=35  (impl 10, fleet_cold 9, fleet_warm 4 —
+#                     the fleet/service suites ride the same bucketed
+#                     batch programs, so the budget is tight by design)
+# The per-ENTRY-POINT companion to these per-module budgets is the
+# declarative RETRACE_BUDGETS table in blance_tpu/analysis/retrace.py,
+# checked by `python -m blance_tpu.analysis --ci` with compiles
+# attributed to their owning dispatch site (obs/device.py).
 _RECOMPILE_BUDGETS = {
     "test_warm_replan": 220,
     "test_sharded": 260,
     "test_sharded_2d": 260,
+    "test_fleet": 50,
 }
 
 
