@@ -704,6 +704,138 @@ def _timed(fn):
     return time.perf_counter() - t0
 
 
+def bench_plan_pipeline(P, N, reps=3):
+    """Fused single-dispatch pipeline vs the staged path (ROADMAP 3).
+
+    The staged path is what production ran before: plan_next_map_tpu
+    (host encode -> device solve -> host decode) plus a separate
+    calc_all_moves device diff.  The fused path is plan_pipeline: one
+    jitted, buffer-donated dispatch chaining solve -> diff -> decode
+    pack, with only the id->name materialization left on host.  Asserts
+    the bit-identity contract (same map AND same move lists) and
+    reports per-phase wall-clock for BOTH paths so the host-phase win
+    is visible in every artifact."""
+    from blance_tpu import model
+    from blance_tpu.moves.batch import calc_all_moves
+    from blance_tpu.obs import device as obs_device
+    from blance_tpu.plan.tensor import plan_next_map_tpu, plan_pipeline
+    from blance_tpu.utils.trace import PhaseTimer
+
+    prev, nodes, removed = _make_map(P, N, seed=23)
+    m = model(primary=(0, 1), replica=(1, 1))
+    opts = _rack_opts(nodes)
+
+    mon = obs_device.CompileMonitor().install()
+    try:
+        # Warm both compiles + pin the identity contract.
+        staged_map, staged_warn = plan_next_map_tpu(
+            prev, prev, nodes, removed, [], m, opts)
+        staged_moves = calc_all_moves(prev, staged_map, m)
+        fused_map, fused_warn, fused_moves = plan_pipeline(
+            prev, prev, nodes, removed, [], m, opts)
+        identical_map = (
+            {k: v.nodes_by_state for k, v in staged_map.items()} ==
+            {k: v.nodes_by_state for k, v in fused_map.items()})
+        identical_moves = staged_moves == fused_moves
+        assert identical_map, "pipeline map diverged from staged path"
+        assert identical_moves, "pipeline moves diverged from staged path"
+        assert staged_warn == fused_warn, "pipeline warnings diverged"
+
+        def staged_once():
+            timer = PhaseTimer()
+            t0 = time.perf_counter()
+            smap, _ = plan_next_map_tpu(prev, prev, nodes, removed, [],
+                                        m, opts, timer=timer)
+            t1 = time.perf_counter()
+            calc_all_moves(prev, smap, m)
+            total = time.perf_counter() - t0
+            phases = {k: round(timer.totals[k] * 1000, 1)
+                      for k in ("encode", "solve", "decode")
+                      if k in timer.totals}
+            phases["diff"] = round((time.perf_counter() - t1) * 1000, 1)
+            phases["total"] = round(total * 1000, 1)
+            return total, phases
+
+        def fused_once():
+            timer = PhaseTimer()
+            t0 = time.perf_counter()
+            plan_pipeline(prev, prev, nodes, removed, [], m, opts,
+                          timer=timer)
+            total = time.perf_counter() - t0
+            phases = {k: round(timer.totals[k] * 1000, 1)
+                      for k in ("encode", "dispatch", "decode",
+                                "materialize")
+                      if k in timer.totals}
+            phases["total"] = round(total * 1000, 1)
+            return total, phases
+
+        staged = min((staged_once() for _ in range(reps)),
+                     key=lambda r: r[0])
+        fused = min((fused_once() for _ in range(reps)),
+                    key=lambda r: r[0])
+    finally:
+        mon.uninstall()
+
+    out = {
+        "P": P, "N": N,
+        "identical_map": identical_map,
+        "identical_moves": identical_moves,
+        # phases_ms for BOTH paths — the per-artifact host-phase
+        # attribution the ISSUE 9 acceptance requires.
+        "phases_ms": {"staged": staged[1], "fused": fused[1]},
+        "staged_ms": round(staged[0] * 1000, 1),
+        "fused_ms": round(fused[0] * 1000, 1),
+        "speedup": round(staged[0] / max(fused[0], 1e-9), 2),
+        "device": _device_block(mon),
+    }
+    log(f"[plan-pipeline {P}x{N}] staged {out['staged_ms']}ms "
+        f"{staged[1]} vs fused {out['fused_ms']}ms {fused[1]} = "
+        f"{out['speedup']}x, identical map={identical_map} "
+        f"moves={identical_moves}")
+    return out
+
+
+def bench_warm_pipeline(P, N):
+    """Warm delta-replan end-to-end through the fused session fast path:
+    one node removed, one donated device dispatch returning the new map
+    AND the move arrays — the sub-100 ms delta-replan target's
+    measurement (ISSUE 9 acceptance)."""
+    from blance_tpu import model
+    from blance_tpu.plan.session import PlannerSession
+
+    nodes = [f"n{i:05d}" for i in range(N)]
+    parts = [str(i) for i in range(P)]
+    m = model(primary=(0, 1), replica=(1, 1))
+    s = PlannerSession(m, nodes, parts, opts=_rack_opts(nodes))
+    s.replan_with_moves()
+    s.apply()
+    # Warm-up delta cycle compiles the warm pipeline program; the timed
+    # cycle below is the steady-state delta replan.
+    s.remove_nodes([nodes[0]])
+    s.replan_with_moves()
+    s.apply()
+    victim = nodes[N // 3]
+    s.remove_nodes([victim])
+    from blance_tpu.obs import get_recorder
+
+    # Delta, not cumulative: the warm-up cycle above already scored a
+    # pipeline.warm, and this field must report the TIMED replan's
+    # outcome (same discipline as bench_delta_replan's carry_hit).
+    w0 = get_recorder().counters.get("plan.pipeline.warm", 0)
+    t0 = time.perf_counter()
+    _assign, (d_nodes, _ds, _do) = s.replan_with_moves()
+    warm_ms = (time.perf_counter() - t0) * 1000
+    s.apply()
+    hit = get_recorder().counters.get("plan.pipeline.warm", 0) - w0 > 0
+    out = {"P": P, "N": N, "warm_e2e_ms": round(warm_ms, 1),
+           "warm_hit": bool(hit),
+           "moves_rows": int((d_nodes >= 0).any(axis=1).sum())}
+    log(f"[warm-pipeline {P}x{N}] delta replan end-to-end "
+        f"{out['warm_e2e_ms']}ms (hit={out['warm_hit']}, "
+        f"{out['moves_rows']} partitions moving)")
+    return out
+
+
 def bench_delta_replan(P, N):
     """Cold vs warm delta replan through PlannerSession: the
     incremental-replanning headline (ISSUE 2).
@@ -899,10 +1031,159 @@ def bench_cpu(P, N):
             "cpu_is_lower_bound": bound}
 
 
+# Child program for one tile-sweep measurement: a fresh subprocess per
+# tile combination (the tiles are jit-static, read once at import — see
+# ops/_tiles.py), timing the fused converged solve AND a fused warm
+# one-sweep repair so the sweep's tile choice covers the delta-replan
+# kernels too.  On a cpu host the kernels run under the pallas
+# interpreter at the caller's (smoke) sizes.
+_TILE_CHILD = r"""
+import json, sys, time
+import jax
+if {cpu!r}:
+    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import bench
+import jax.numpy as jnp
+from blance_tpu.plan.tensor import (carry_from_assignment,
+                                    solve_dense_converged,
+                                    solve_dense_warm)
+from blance_tpu.ops import reduce2, score_fused
+P, N, mode, runs = {P}, {N}, {mode!r}, {runs}
+args = bench.build_dense(P, N)
+(prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+ constraints, rules) = args
+dev = [jnp.asarray(a) for a in
+       (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+def run():
+    out = solve_dense_converged(*dev, constraints, rules, fused_score=mode,
+                                record=False)
+    np.asarray(out[:, 0, 0])  # force completion (axon quirk)
+    return out
+t0 = time.perf_counter(); out = run(); compile_s = time.perf_counter() - t0
+times = []
+for _ in range(runs):
+    t0 = time.perf_counter(); run(); times.append(time.perf_counter() - t0)
+# Warm one-sweep repair through the same kernels (tile choice feeds the
+# Pallas warm-repair path too).
+out_np = np.asarray(out)
+dirty = np.zeros(P, bool); dirty[: max(P // 64, 1)] = True
+warm_times = []
+for _ in range(max(runs - 1, 1)):
+    carry = carry_from_assignment(jnp.asarray(out_np), dev[1], dev[2])
+    t0 = time.perf_counter()
+    solve_dense_warm(out_np, *dev[1:7], constraints, rules, dirty=dirty,
+                     carry=carry, fused_score=mode, record=False)
+    warm_times.append(time.perf_counter() - t0)
+print(json.dumps({{
+    "tile_p": score_fused._TILE_P, "tile_n": score_fused._TILE_N,
+    "reduce2_tile_p": reduce2._TILE_P, "reduce2_tile_n": reduce2._TILE_N,
+    "compile_s": round(compile_s, 1),
+    "solve_ms_min": round(min(times) * 1000, 2),
+    "solve_ms_runs": [round(t * 1000, 2) for t in times],
+    "warm_ms_min": round(min(warm_times) * 1000, 2)}}))
+"""
+
+
+def run_tile_sweep(P=None, N=None):
+    """bench.py --tile-sweep: the fused-kernel tile sweep as a
+    first-class stage with a parseable JSON artifact (previously the
+    orphan docs/bench_tile_sweep.py).  Sweeps BLANCE_FUSED_TILE_P/N and
+    BLANCE_REDUCE2_TILE_P/N together over aligned candidates, one
+    subprocess per combination, and prints ONE artifact line naming the
+    winning tile — the value to export before latency-critical runs.
+    On a TPU host the sweep runs the compiled kernels at the (default)
+    north-star shape; cpu hosts degrade to interpret-mode smoke sizes
+    so the artifact shape is always producible."""
+    import subprocess
+
+    import jax
+
+    cpu = jax.default_backend() != "tpu"
+    if cpu:
+        P, N = P or 256, N or 32
+        grid = [(256, 2048), (512, 2048)]
+        mode, runs, timeout = "interpret", 1, 900
+        log(f"tile-sweep: no TPU (backend {jax.default_backend()}); "
+            f"interpret-mode smoke at {P}x{N}")
+    else:
+        P, N = P or 100_000, N or 10_000
+        grid = [(tp, tn) for tp in (128, 256, 512)
+                for tn in (1024, 2048, 4096)]
+        mode, runs, timeout = "on", 4, 600
+    results = []
+    for tile_p, tile_n in grid:
+        env = dict(os.environ,
+                   BLANCE_FUSED_TILE_P=str(tile_p),
+                   BLANCE_FUSED_TILE_N=str(tile_n),
+                   BLANCE_REDUCE2_TILE_P=str(tile_p),
+                   BLANCE_REDUCE2_TILE_N=str(tile_n))
+        child = _TILE_CHILD.format(
+            repo=os.path.dirname(os.path.abspath(__file__)),
+            P=P, N=N, mode=mode, runs=runs, cpu=cpu)
+        t0 = time.time()
+        try:
+            r = subprocess.run([sys.executable, "-c", child], env=env,
+                               timeout=timeout, capture_output=True,
+                               text=True, check=True)
+            lines = r.stdout.strip().splitlines()
+            res = json.loads(lines[-1]) if lines else {
+                "error": "no output"}
+        except subprocess.TimeoutExpired:
+            res = {"error": "timeout",
+                   "elapsed_s": round(time.time() - t0)}
+        except (subprocess.CalledProcessError, ValueError) as e:
+            err = (getattr(e, "stderr", "") or str(e)).strip()
+            res = {"error": err.splitlines()[-1][-200:]
+                   if err else "failed"}
+        # Keep the CHILD-reported tiles (the values actually compiled
+        # in) — overwriting them would destroy the only evidence the
+        # env override applied; flag a propagation break instead.
+        res.setdefault("tile_p", tile_p)
+        res.setdefault("tile_n", tile_n)
+        if "solve_ms_min" in res and (res["tile_p"] != tile_p
+                                      or res["tile_n"] != tile_n):
+            res["error"] = (f"env override did not apply: child "
+                            f"compiled {res['tile_p']}x{res['tile_n']}")
+            res.pop("solve_ms_min", None)
+        log(f"tile-sweep {tile_p}x{tile_n}: "
+            + (f"{res['solve_ms_min']}ms solve / "
+               f"{res.get('warm_ms_min')}ms warm"
+               if "solve_ms_min" in res else res.get("error", "?")))
+        results.append(res)
+    done = [r for r in results if "solve_ms_min" in r]
+    best = min(done, key=lambda r: r["solve_ms_min"]) if done else None
+    print(json.dumps({
+        "metric": f"fused-kernel tile sweep @ {P}x{N} ({mode})",
+        "value": best["solve_ms_min"] if best else None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "detail": {"P": P, "N": N, "mode": mode, "results": results,
+                   "best": best,
+                   "env": (None if best is None else {
+                       "BLANCE_FUSED_TILE_P": best["tile_p"],
+                       "BLANCE_FUSED_TILE_N": best["tile_n"],
+                       "BLANCE_REDUCE2_TILE_P": best["tile_p"],
+                       "BLANCE_REDUCE2_TILE_N": best["tile_n"]})},
+        "pass": best is not None,
+    }))
+    if best is None:
+        sys.exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny sizes (code-path test on CPU)")
+    ap.add_argument("--tile-sweep", action="store_true",
+                    help="sweep the Pallas kernel tile sizes (one "
+                         "subprocess per combination) and emit a JSON "
+                         "artifact naming the winner; interpret-mode "
+                         "smoke on cpu hosts")
+    ap.add_argument("--tile-sweep-shape", default=None, metavar="PxN",
+                    help="override the tile sweep problem shape, e.g. "
+                         "100000x10000")
     ap.add_argument("--perf-smoke", action="store_true",
                     help="CI guard: run ONLY the delta-replan stage at "
                          "smoke size on CPU and fail (exit 1) if the "
@@ -917,6 +1198,13 @@ def main():
     args = ap.parse_args()
 
     smoke = args.smoke
+
+    if args.tile_sweep:
+        tp = tn = None
+        if args.tile_sweep_shape:
+            tp, tn = (int(x) for x in args.tile_sweep_shape.split("x"))
+        run_tile_sweep(tp, tn)
+        return
 
     if args.perf_smoke:
         # CI perf guard: CPU-pinned, delta-replan stage only, asserting
@@ -1127,18 +1415,37 @@ def _run_perf_smoke():
     res = bench_delta_replan(512, 64)
     ok = (res["identical"] and res["warm_carry_hit"]
           and res["warm_sweeps"] * 2 <= res["cold_sweeps"])
+
+    # Pipeline gate (ISSUE 9): the fused single-dispatch pipeline must
+    # stay bit-identical to the staged path (map AND move lists) and
+    # beat it end-to-end at smoke sizes — the dispatch-count win must
+    # not silently erode back into staged-path territory.  The timing
+    # half is inherently wall-clock (unlike the sweep-count gate above):
+    # min-of-5 on both sides damps CI-runner noise, and the structural
+    # margin (one dispatch + no host decode pack/diff re-encode vs
+    # four boundaries) is ~40% at this shape, not knife-edge.
+    try:
+        pipe = bench_plan_pipeline(512, 64, reps=5)
+        pipe_ok = (pipe["identical_map"] and pipe["identical_moves"]
+                   and pipe["fused_ms"] < pipe["staged_ms"])
+    except AssertionError as e:
+        pipe = {"error": first_line(e)}
+        pipe_ok = False
+    ok = ok and pipe_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
         "unit": "sweeps",
         "vs_baseline": res["cold_sweeps"],
-        "detail": res,
+        "detail": {**res, "pipeline": pipe},
         "pass": ok,
     }))
     if not ok:
         log(f"PERF-SMOKE FAILED: warm={res['warm_sweeps']} sweeps vs "
             f"cold={res['cold_sweeps']} (hit={res['warm_carry_hit']}, "
-            f"identical={res['identical']})")
+            f"identical={res['identical']}); pipeline "
+            f"{'OK' if pipe_ok else f'FAILED: {pipe}'}")
         sys.exit(1)
 
 
@@ -1223,6 +1530,12 @@ def _run_benchmarks(smoke, backend_note=None):
                                "violations")})
                 entry["engine"] = "fused"
         if "solve_ms_min" not in entry:
+            # The engine tag must be present and truthful even when no
+            # engine produced a number (the BENCH_local_r04 shape was a
+            # matrix_error with the fused result carrying the config —
+            # a both-engines-failed config previously had NO engine key,
+            # so top-level and per-config reporting could disagree).
+            entry["engine"] = backend_note or "none-failed"
             log(f"[{P}x{N}] no engine produced a result; config recorded "
                 f"as failed")
             save_progress(detail, f"solve {P}x{N} failed")
@@ -1300,6 +1613,22 @@ def _run_benchmarks(smoke, backend_note=None):
             f"({type(e).__name__}: {first_line(e)})")
         detail["delta_replan_error"] = first_line(e)
     save_progress(detail, "delta-replan done")
+
+    # Plan-pipeline stage: the fused single-dispatch encode→solve→diff
+    # →decode-pack program vs the staged path — bit-identity asserted,
+    # phases_ms reported for BOTH paths (the host-phase win), plus the
+    # warm delta-replan end-to-end through the session fast path.
+    try:
+        pp, pn = (512, 64) if smoke else (100_000, 1_000)
+        detail["plan_pipeline"] = bench_plan_pipeline(pp, pn)
+        detail["plan_pipeline"]["warm"] = bench_warm_pipeline(pp, pn)
+    except AssertionError:
+        raise  # identity divergence is a correctness regression
+    except Exception as e:  # must not eat the solve numbers
+        log(f"plan-pipeline stage failed "
+            f"({type(e).__name__}: {first_line(e)})")
+        detail["plan_pipeline_error"] = first_line(e)
+    save_progress(detail, "plan-pipeline done")
 
     # Fleet stage: 64 small tenant indexes solved per-tenant (the loop a
     # fleet replan runs today) vs batched by bucket class through the

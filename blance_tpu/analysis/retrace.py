@@ -52,6 +52,16 @@ RETRACE_BUDGETS: dict[str, int] = {
     # (calibrated 18 on the 8-virtual-device host, both dispatches);
     # a per-dispatch retrace doubles it.
     "sharded.cold": 26,
+    # Fused single-dispatch plan pipeline (plan/tensor.py): one program
+    # per mode; four dispatches each in the workload, so a per-call
+    # retrace quadruples the count.
+    "pipeline.cold": 2,
+    "pipeline.warm": 2,
+    # The sharded pipeline dispatch is memoized + jitted per (mesh,
+    # statics) (parallel/sharded._pipeline_sharded_fn), so repeat
+    # dispatches compile NOTHING: calibrated 1 compile for the
+    # workload's two cold dispatches, headroom for a warm program.
+    "sharded.pipeline": 4,
     # jax-internal eager helper jits (asarray converts, carry scatters);
     # population varies across jax patch versions, so generous.
     "other": 48,
@@ -167,15 +177,39 @@ def _workload() -> None:
             gids=gids, gid_valid=gv, constraints=constraints,
             rules=rules, carry=r.carry, dirty=dirty) for r in res_w]
 
-    # sharded.cold — a tiny 2-shard mesh dispatch, twice (skipped on a
-    # single-device host; the budget is then trivially met).
+    # pipeline.cold + pipeline.warm — the fused single-dispatch plan
+    # pipeline through the session fast path (the real dispatch sites):
+    # one cold dispatch, then four warm delta cycles riding the carry.
+    # Every dispatch after the first per mode must hit the jit cache.
+    from ..plan.session import PlannerSession
+
+    s_nodes = [f"n{i:03d}" for i in range(N)]
+    sess = PlannerSession(m, s_nodes, [str(i) for i in range(P)],
+                          opts=PlanOptions())
+    sess.replan_with_moves()
+    sess.apply()
+    for i in range(4):
+        sess.remove_nodes([s_nodes[i]])
+        sess.replan_with_moves()
+        sess.apply()
+
+    # sharded.cold / sharded.pipeline — tiny 2-shard mesh dispatches,
+    # twice each (skipped on a single-device host; the budgets are then
+    # trivially met).
     if len(jax.devices()) >= 2:
-        from ..parallel.sharded import make_mesh, solve_dense_sharded
+        from ..parallel.sharded import (
+            make_mesh,
+            solve_dense_sharded,
+            solve_pipeline_sharded,
+        )
 
         mesh = make_mesh(2)
         for _ in range(2):
             solve_dense_sharded(mesh, prev, pw, nw, valid, stick, gids,
                                 gv, constraints, rules)
+        for _ in range(2):
+            solve_pipeline_sharded(mesh, prev, pw, nw, valid, stick,
+                                   gids, gv, constraints, rules)
 
 
 def run_retrace_check() -> tuple[list["Finding"], int]:

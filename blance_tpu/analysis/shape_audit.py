@@ -22,6 +22,10 @@ Covered entry points (acceptance contract):
 - fleet batch solves         — ``plan.fleet._fleet_cold_batch`` /
   ``_fleet_warm_batch``, the vmapped bucket-class programs the
   multi-tenant tier dispatches (stacked ``[B, ...]`` layouts)
+- fused plan pipeline        — ``plan.tensor._pipeline_cold_impl`` /
+  ``_pipeline_warm_impl`` (cold/carry/bucketed/warm: the one-dispatch
+  solve→diff→pack programs), plus both under ``shard_map`` with specs
+  derived from ``parallel/sharded``'s declarative layout tables
 - carry construction         — ``carry_from_assignment`` / ``_carry_used_jit``
 - ``encode_problem`` / ``decode_assignment`` — dense-encoding dtypes and
   the decode round trip (tiny concrete problem; host-only, milliseconds)
@@ -185,11 +189,11 @@ def _build_carry_used(d: Dims):
 
 def _build_sharded(d: Dims):
     """solve_dense under shard_map, the exact in/out layout
-    solve_dense_sharded builds (partition axis sharded, [N] vectors
-    replicated)."""
+    solve_dense_sharded builds — in_specs derived from the SAME
+    declarative layout table the runtime dispatch uses
+    (parallel/sharded.SOLVER_IN_LAYOUT), so the audited layout cannot
+    drift from the dispatched one."""
     from functools import partial
-
-    import numpy as np
 
     import jax
     import jax.numpy as jnp
@@ -197,8 +201,10 @@ def _build_sharded(d: Dims):
 
     from ..parallel.sharded import (
         PARTITION_AXIS,
+        SOLVER_IN_LAYOUT,
         _build_checked,
         _shard_map,
+        layout_specs,
         make_mesh,
     )
     from ..plan.tensor import solve_dense
@@ -207,17 +213,134 @@ def _build_sharded(d: Dims):
     shards = n_dev if d.P % n_dev == 0 else 1
     mesh = make_mesh(shards)
     shard = PartitionSpec(PARTITION_AXIS)
-    rep = PartitionSpec()
     body = partial(solve_dense, constraints=d.constraints, rules=d.rules,
                    axis_name=PARTITION_AXIS, fused_score="off")
     sm = partial(_shard_map, body, mesh=mesh,
-                 in_specs=(shard, shard, rep, rep, shard, rep, rep),
+                 in_specs=layout_specs(SOLVER_IN_LAYOUT),
                  out_specs=shard)
     # Same replication-checker policy as solve_dense_sharded: pre-vma
     # JAX has no replication rule for the auction while_loop.
     has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
     fn = _build_checked(sm, has_vma)
     return fn, _solver_args(d, jnp), {}
+
+
+def _diff_len(d: Dims) -> int:
+    """The device move-diff's padded op-list length (moves/batch.py)."""
+    return 2 * d.S * d.R
+
+
+def _expect_pipeline_cold(d: Dims):
+    import numpy as np
+
+    L = _diff_len(d)
+    return (
+        _expect_assign(d),  # assign
+        ((), "int32"),  # sweeps
+        ((d.N,), np.float32),  # prices
+        _expect_used(d),  # used
+        ((d.P, L), np.int32),  # d_nodes
+        ((d.P, L), np.int32),  # d_states
+        ((d.P, L), np.int32),  # d_ops
+        _expect_assign(d),  # packed
+        ((d.P, d.S), np.int32),  # counts
+    )
+
+
+def _expect_pipeline_warm(d: Dims):
+    import numpy as np
+
+    L = _diff_len(d)
+    return (
+        _expect_assign(d),
+        ((d.N,), np.float32),  # prices
+        _expect_used(d),
+        ((), "bool"),  # ok
+        ((d.P, L), np.int32),
+        ((d.P, L), np.int32),
+        ((d.P, L), np.int32),
+        _expect_assign(d),
+        ((d.P, d.S), np.int32),
+    )
+
+
+def _build_pipeline_cold(d: Dims, carry: bool = False,
+                         bucketed: bool = False):
+    import numpy as np
+
+    from ..plan.tensor import _pipeline_cold_impl
+
+    kwargs = {"constraints": d.constraints, "rules": d.rules,
+              "fused_score": "off", "max_iterations": 4,
+              "favor_min_nodes": False}
+    if carry:
+        kwargs["carry_used"] = _sds((d.S, d.N), np.float32)
+    if bucketed:
+        kwargs["p_real"] = _sds((), np.float32)
+    return _pipeline_cold_impl, _solver_args(d, None), kwargs
+
+
+def _build_pipeline_warm(d: Dims):
+    import numpy as np
+
+    from ..plan.tensor import _pipeline_warm_impl
+
+    args = _solver_args(d, None) + (
+        _sds((d.P,), np.bool_),  # dirty
+        _sds((d.S, d.N), np.float32),  # carry_used
+    )
+    return _pipeline_warm_impl, args, {
+        "constraints": d.constraints, "rules": d.rules,
+        "fused_score": "off", "favor_min_nodes": False}
+
+
+def _build_pipeline_sharded(d: Dims, warm: bool = False):
+    """The fused pipeline under shard_map, in/out specs straight from
+    the runtime's declarative layout tables — the exact dispatch
+    solve_pipeline_sharded builds."""
+    from functools import partial
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.sharded import (
+        PARTITION_AXIS,
+        PIPELINE_COLD_OUT_LAYOUT,
+        PIPELINE_WARM_OUT_LAYOUT,
+        SOLVER_IN_LAYOUT,
+        WARM_EXTRA_LAYOUT,
+        _build_checked,
+        _shard_map,
+        layout_specs,
+        make_mesh,
+    )
+    from ..plan.tensor import _pipeline_cold_impl, _pipeline_warm_impl
+
+    n_dev = len(jax.devices())
+    shards = n_dev if d.P % n_dev == 0 else 1
+    mesh = make_mesh(shards)
+    if warm:
+        body = partial(_pipeline_warm_impl, constraints=d.constraints,
+                       rules=d.rules, axis_name=PARTITION_AXIS,
+                       fused_score="off", favor_min_nodes=False)
+        in_layout = SOLVER_IN_LAYOUT + WARM_EXTRA_LAYOUT
+        out_layout = PIPELINE_WARM_OUT_LAYOUT
+        extra = (_sds((d.P,), np.bool_), _sds((d.S, d.N), np.float32))
+    else:
+        body = partial(_pipeline_cold_impl, constraints=d.constraints,
+                       rules=d.rules, axis_name=PARTITION_AXIS,
+                       max_iterations=4, fused_score="off",
+                       favor_min_nodes=False)
+        in_layout = SOLVER_IN_LAYOUT
+        out_layout = PIPELINE_COLD_OUT_LAYOUT
+        extra = ()
+    sm = partial(_shard_map, body, mesh=mesh,
+                 in_specs=layout_specs(in_layout),
+                 out_specs=layout_specs(out_layout))
+    fn = _build_checked(sm, False)  # checker off: psum'd replicated outs
+    return fn, _solver_args(d, jnp) + extra, {}
 
 
 def _bucketed_dims(d: Dims) -> Dims:
@@ -378,6 +501,44 @@ CONTRACTS: tuple[ShapeContract, ...] = tuple(
             variant=f"B{_FLEET_B}@{d.P}x{d.N}",
             build=(lambda d=d: _build_fleet_warm(d)),
             expect=(lambda d=d: _expect_fleet_warm(d)))
+        for d in _MATRIX
+    ] + [
+        # -- fused single-dispatch plan pipeline (solve→diff→pack) -----
+        ShapeContract(
+            entry="plan_pipeline", variant=f"cold@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_cold(d)),
+            expect=(lambda d=d: _expect_pipeline_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="plan_pipeline", variant=f"carry@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_cold(d, carry=True)),
+            expect=(lambda d=d: _expect_pipeline_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="plan_pipeline", variant=f"bucketed@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_cold(
+                _bucketed_dims(d), bucketed=True)),
+            expect=(lambda d=d: _expect_pipeline_cold(_bucketed_dims(d))))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="plan_pipeline", variant=f"warm@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_warm(d)),
+            expect=(lambda d=d: _expect_pipeline_warm(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="plan_pipeline_sharded", variant=f"cold@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_sharded(d)),
+            expect=(lambda d=d: _expect_pipeline_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="plan_pipeline_sharded", variant=f"warm@{d.P}x{d.N}",
+            build=(lambda d=d: _build_pipeline_sharded(d, warm=True)),
+            expect=(lambda d=d: _expect_pipeline_warm(d)))
         for d in _MATRIX
     ]
 )

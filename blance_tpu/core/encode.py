@@ -35,7 +35,9 @@ from .types import (
 
 __all__ = ["DenseProblem", "encode_problem", "decode_assignment",
            "bucket_size", "pad_to", "pad_problem_arrays",
-           "stack_problem_arrays"]
+           "stack_problem_arrays", "pack_assignment_core",
+           "pack_assignment", "prev_from_entries_core",
+           "prev_from_entries"]
 
 # Shape-bucket granularity: buckets per power-of-two octave.  8 keeps the
 # worst-case padding overhead at 1/8 = 12.5% of the axis while collapsing
@@ -126,6 +128,79 @@ def stack_problem_arrays(
     return tuple(
         np.stack([np.asarray(arrs[i]) for arrs in padded])
         for i in range(width))
+
+
+# --- device integer cores ---------------------------------------------------
+#
+# The string<->id interning at the map edges is inherently host work, but
+# the INTEGER cores of encode (filling prev[P, S, R] from interned
+# entries) and decode (packing each state row's non-empty slots left and
+# counting them) are pure array programs.  They live here as traceable
+# jnp functions so the fused plan pipeline (plan/tensor.plan_pipeline)
+# can run them INSIDE its single jitted dispatch — decode's host share
+# shrinks to one id->name gather, and nothing round-trips between solve
+# and diff.  jax imports stay function-local: this module is also the
+# greedy/native path's encoder, which must import without touching jax.
+
+
+def pack_assignment_core(assign):  # type: ignore[no-untyped-def]
+    """Decode's integer core, traceable: pack every (partition, state)
+    row's non-empty slots left (stable, preserving slot order) and count
+    them.  [P, S, R] int32 -> (packed [P, S, R] int32, counts [P, S]
+    int32).  Bit-equivalent to the numpy pack in decode_assignment
+    (pinned by tests), so device-packed rows feed the same host
+    materializer."""
+    import jax.numpy as jnp
+
+    mask = assign >= 0
+    order = jnp.argsort(~mask, axis=2, stable=True)
+    packed = jnp.take_along_axis(assign, order, axis=2)
+    counts = jnp.sum(mask, axis=2, dtype=jnp.int32)
+    return packed, counts
+
+
+_pack_assignment_jit = None
+
+
+def pack_assignment(assign):  # type: ignore[no-untyped-def]
+    """Host-facing jitted spelling of :func:`pack_assignment_core`."""
+    global _pack_assignment_jit
+    if _pack_assignment_jit is None:
+        import jax
+
+        _pack_assignment_jit = jax.jit(pack_assignment_core)
+    return _pack_assignment_jit(assign)
+
+
+def prev_from_entries_core(pi, si, ri, node, p: int, s: int, r: int):  # type: ignore[no-untyped-def]
+    """Encode's integer core, traceable: scatter interned (partition,
+    state, slot, node) entry columns into a dense prev[P, S, R] (-1
+    empties).  Out-of-range entries drop (mode="drop"), so callers can
+    pad entry lists with -1 rows.  Equivalent to encode_problem's host
+    fill loop for already-interned entries (pinned by tests) — the
+    spelling a device-resident caller uses to apply map deltas without
+    re-marshalling strings."""
+    import jax.numpy as jnp
+
+    flat = pi * (s * r) + si * r + ri
+    flat = jnp.where((pi >= 0) & (si >= 0) & (ri >= 0), flat, p * s * r)
+    return jnp.full((p * s * r,), -1, jnp.int32).at[flat].set(
+        node.astype(jnp.int32), mode="drop").reshape(p, s, r)
+
+
+_prev_from_entries_jit = None
+
+
+def prev_from_entries(pi, si, ri, node, p: int, s: int, r: int):  # type: ignore[no-untyped-def]
+    """Jitted spelling of :func:`prev_from_entries_core` (static dims)."""
+    global _prev_from_entries_jit
+    if _prev_from_entries_jit is None:
+        import jax
+        from functools import partial as _partial
+
+        _prev_from_entries_jit = _partial(
+            jax.jit, static_argnames=("p", "s", "r"))(prev_from_entries_core)
+    return _prev_from_entries_jit(pi, si, ri, node, p=p, s=s, r=r)
 
 
 @dataclass
@@ -322,6 +397,9 @@ def decode_assignment(
     assign: np.ndarray,  # [P, S, R] int32 node ids, -1 empty
     partitions_to_assign: PartitionMap,
     nodes_to_remove: Optional[list[str]] = None,
+    *,
+    packed: Optional[np.ndarray] = None,  # [P, S, R] device-packed rows
+    counts: Optional[np.ndarray] = None,  # [P, S] per-row filled counts
 ) -> tuple[PartitionMap, dict[str, list[str]]]:
     """Dense assignment -> PartitionMap + constraint-shortfall warnings.
 
@@ -330,10 +408,18 @@ def decode_assignment(
     states.  Vectorized over P: the id->name gather, empty-slot packing and
     shortfall detection run as whole-array numpy ops so decode stays off the
     end-to-end critical path at 100k partitions (BASELINE.md).
+
+    ``packed``/``counts`` (both or neither) short-circuit the host pack:
+    the fused plan pipeline computes them on device inside its single
+    dispatch (:func:`pack_assignment_core`), leaving only the id->name
+    gather and list building here.
     """
     assign = np.asarray(assign)
     warnings: dict[str, list[str]] = {}
     P = problem.P
+    if (packed is None) != (counts is None):
+        raise ValueError("decode_assignment: packed and counts must be "
+                         "passed together")
 
     # Per modeled state with constraints > 0: pack non-empty slots left
     # (stable, preserving slot order), gather names in one shot, and convert
@@ -351,19 +437,23 @@ def decode_assignment(
             per_state_rows[si] = [[] for _ in range(P)]
             per_state_counts[si] = np.zeros(P, dtype=np.int64)
             continue
-        ids = assign[:, si, :]
-        mask = ids >= 0
-        counts = mask.sum(axis=1)
-        order = np.argsort(~mask, axis=1, kind="stable")
-        packed = np.take_along_axis(ids, order, axis=1)
-        names = names_arr[np.maximum(packed, 0)]
+        if packed is not None and counts is not None:
+            row_ids = np.asarray(packed)[:, si, :]
+            row_counts = np.asarray(counts)[:, si].astype(np.int64)
+        else:
+            ids = assign[:, si, :]
+            mask = ids >= 0
+            row_counts = mask.sum(axis=1)
+            order = np.argsort(~mask, axis=1, kind="stable")
+            row_ids = np.take_along_axis(ids, order, axis=1)
+        names = names_arr[np.maximum(row_ids, 0)]
         nested = names.tolist()
-        if counts.min() == ids.shape[1]:  # all slots filled: no trimming
+        if row_counts.min() == row_ids.shape[1]:  # all slots filled
             per_state_rows[si] = nested
         else:
             per_state_rows[si] = [
-                row[:c] for row, c in zip(nested, counts.tolist())]
-        per_state_counts[si] = counts
+                row[:c] for row, c in zip(nested, row_counts.tolist())]
+        per_state_counts[si] = row_counts
 
     # Partitions needing the slow path: source has unmodeled or
     # zero-constraint states to pass through (rare in practice).

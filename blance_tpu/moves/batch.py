@@ -32,7 +32,8 @@ from ..core.types import PartitionMap, PartitionModel
 from ..obs import get_recorder
 from .calc import NodeStateOp
 
-__all__ = ["diff_assignments", "calc_all_moves", "OP_NAMES"]
+__all__ = ["diff_assignments", "calc_all_moves", "moves_from_arrays",
+           "OP_NAMES"]
 
 OP_NAMES = ["add", "del", "promote", "demote"]
 _OP_ADD, _OP_DEL, _OP_PROMOTE, _OP_DEMOTE = 0, 1, 2, 3
@@ -145,6 +146,49 @@ def diff_assignments(
             take(ops, order, 1)[:, :L])
 
 
+def moves_from_arrays(
+    partition_names: "list[str]",
+    state_names: "list[str]",
+    node_names: "list[str]",
+    d_nodes: np.ndarray,  # [P, L] int32 node ids, -1 padding
+    d_states: np.ndarray,  # [P, L] int32 state ids, -1 = "" (del)
+    d_ops: np.ndarray,  # [P, L] int32 op codes, -1 padding
+) -> dict[str, list[NodeStateOp]]:
+    """Materialize device diff tensors into per-partition ordered
+    NodeStateOp lists — THE host step of the batched move calculus,
+    shared by calc_all_moves and the fused plan pipeline
+    (plan/tensor.plan_pipeline), so the two paths cannot drift.
+
+    Valid entries sort to the front of each row (the device diff's
+    invalid keys are 2^30), so row pi's moves are its first counts[pi]
+    flat entries.  One pass over the ~total-op count instead of P x L
+    Python iterations.  Returns a dict keyed by ``partition_names``
+    order; records ``moves.total_ops`` on the ambient Recorder.
+    """
+    d_nodes = np.asarray(d_nodes)
+    d_states = np.asarray(d_states)
+    d_ops = np.asarray(d_ops)
+    P = len(partition_names)
+    mask = d_ops >= 0
+    counts = mask.sum(axis=1)
+    flat = mask.reshape(-1)
+    node_arr = np.asarray(node_names, dtype=object)[
+        d_nodes.reshape(-1)[flat]]
+    state_arr = np.asarray(list(state_names) + [""], dtype=object)
+    state_vals = state_arr[d_states.reshape(-1)[flat]]  # -1 wraps to ""
+    op_arr = np.asarray(OP_NAMES, dtype=object)
+    op_vals = op_arr[d_ops.reshape(-1)[flat]]
+    flat_moves = [NodeStateOp(n_, s_, o_) for n_, s_, o_ in
+                  zip(node_arr.tolist(), state_vals.tolist(),
+                      op_vals.tolist())]
+    offsets = np.zeros(P + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    out = {name: flat_moves[offsets[pi]:offsets[pi + 1]]
+           for pi, name in enumerate(partition_names)}
+    get_recorder().count("moves.total_ops", int(counts.sum()))
+    return out
+
+
 def calc_all_moves(
     beg_map: PartitionMap,
     end_map: PartitionMap,
@@ -251,34 +295,12 @@ def _calc_all_moves(
     from .calc import calc_partition_moves
 
     with rec.span("moves.materialize"):
-        # Materialize ops flat: valid entries sort to the front of each row
-        # (invalid keys are 2^30), so row pi's moves are its first
-        # counts[pi] flat entries.  One pass over the ~total-op count
-        # instead of P x L Python iterations.
-        mask = d_ops >= 0
-        counts = mask.sum(axis=1)
-        flat = mask.reshape(-1)
-        node_names = np.asarray(nodes, dtype=object)[
-            d_nodes.reshape(-1)[flat]]
-        state_arr = np.asarray(states + [""], dtype=object)
-        state_names = state_arr[d_states.reshape(-1)[flat]]  # -1 wraps to ""
-        op_arr = np.asarray(OP_NAMES, dtype=object)
-        op_names = op_arr[d_ops.reshape(-1)[flat]]
-        flat_moves = [NodeStateOp(n_, s_, o_) for n_, s_, o_ in
-                      zip(node_names.tolist(), state_names.tolist(),
-                          op_names.tolist())]
-        offsets = np.zeros(P + 1, np.int64)
-        np.cumsum(counts, out=offsets[1:])
-
-        out: dict[str, list[NodeStateOp]] = {}
-        for pi, name in enumerate(names):
-            if name in irregular:
-                out[name] = calc_partition_moves(
-                    states,
-                    beg_map[name].nodes_by_state,
-                    end_map[name].nodes_by_state,
-                    favor_min_nodes)
-            else:
-                out[name] = flat_moves[offsets[pi]:offsets[pi + 1]]
-        rec.count("moves.total_ops", int(counts.sum()))
+        out = moves_from_arrays(names, states, nodes,
+                                d_nodes, d_states, d_ops)
+        for name in irregular:
+            out[name] = calc_partition_moves(
+                states,
+                beg_map[name].nodes_by_state,
+                end_map[name].nodes_by_state,
+                favor_min_nodes)
         return out

@@ -174,6 +174,20 @@ def default_registry() -> MetricsRegistry:
                "fraction of partitions each delta replan marked dirty"),
         Metric("plan.engine_fallback", "counter",
                "score-engine fallbacks (fused -> matrix)"),
+        # -- fused plan pipeline (plan/tensor.plan_pipeline +
+        # PlannerSession.replan_with_moves) ---------------------------------
+        Metric("plan.pipeline.calls", "counter",
+               "fused plan-pipeline invocations (solve->diff->pack in "
+               "one device dispatch)"),
+        Metric("plan.pipeline.warm", "counter",
+               "pipeline dispatches resolved by the one-sweep warm "
+               "repair (accepted through every gate)"),
+        Metric("plan.pipeline.fallback", "counter",
+               "pipeline dispatch failures degraded to the staged "
+               "encode/solve/decode path"),
+        Metric("plan.pipeline.dispatch_s", "histogram",
+               "wall-clock seconds per fused pipeline device dispatch "
+               "(solve + diff + pack, one program)"),
         Metric("plan.greedy.candidates", "histogram",
                "candidates scored per greedy (partition, state) pick"),
         # -- moves -----------------------------------------------------------

@@ -39,10 +39,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._tiles import tile_env
+
 __all__ = ["min2_argmin", "min2_argmin_reference", "priced_min2_argmin",
            "pallas_available"]
 
 _INF = float("inf")
+
+# Default tile shape for the priced reduction, overridable for tuning
+# sweeps (bench.py --tile-sweep).  This kernel is the matrix engine's hot
+# op in BOTH the cold fixpoint and the warm one-sweep repair, so the
+# sweep's tile choice feeds the delta-replan path too.  Read once at
+# import (jit-static; see ops/_tiles.py).
+_TILE_P = tile_env("BLANCE_REDUCE2_TILE_P", 256, 8)
+_TILE_N = tile_env("BLANCE_REDUCE2_TILE_N", 2048, 128)
 
 
 def min2_argmin_reference(eff: jnp.ndarray):
@@ -106,8 +116,8 @@ def priced_min2_argmin(
     score: jnp.ndarray,
     price: jnp.ndarray,
     *,
-    tile_p: int = 256,
-    tile_n: int = 2048,
+    tile_p: int = _TILE_P,
+    tile_n: int = _TILE_N,
     interpret: bool = False,
 ):
     """Fused (best, argmin, second-min) over axis 1 of ``score + price``.
@@ -155,8 +165,8 @@ def priced_min2_argmin(
 def min2_argmin(
     eff: jnp.ndarray,
     *,
-    tile_p: int = 256,
-    tile_n: int = 2048,
+    tile_p: int = _TILE_P,
+    tile_n: int = _TILE_N,
     interpret: bool = False,
 ):
     """Fused (best, argmin, second-min) over axis 1 of ``eff[P, N]``."""

@@ -27,39 +27,20 @@ device batch before enabling the fused path for timed runs.
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# Tile shape for the score kernel, overridable for tuning sweeps.  Read
-# once at import: the values are jit-static, so changing them mid-process
-# would silently recompile rather than retune.
+from ._tiles import tile_env
 
-
-def _tile_env(name: str, default: int, multiple: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        raise ValueError(f"{name}={raw!r} is not an integer") from None
-    if v < 1:
-        raise ValueError(f"{name}={v} must be >= 1")
-    if v % multiple:
-        # An unaligned tile dies deep inside Mosaic with an opaque
-        # lowering error; reject it here with the env var's name instead.
-        raise ValueError(
-            f"{name}={v} must be a multiple of {multiple} (TPU "
-            f"sublane/lane alignment)")
-    return v
-
-
-_TILE_P = _tile_env("BLANCE_FUSED_TILE_P", 256, 8)
-_TILE_N = _tile_env("BLANCE_FUSED_TILE_N", 2048, 128)
+# Tile shape for the score kernel, overridable for tuning sweeps
+# (bench.py --tile-sweep).  Read once at import: the values are
+# jit-static, so changing them mid-process would silently recompile
+# rather than retune.
+_TILE_P = tile_env("BLANCE_FUSED_TILE_P", 256, 8)
+_TILE_N = tile_env("BLANCE_FUSED_TILE_N", 2048, 128)
 
 try:  # ``vma`` on ShapeDtypeStruct arrived with JAX's varying-axes model
     jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())

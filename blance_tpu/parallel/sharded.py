@@ -23,7 +23,7 @@ plan/tensor.py solve_dense's node_axis docs).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import numpy as np
@@ -35,6 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.encode import DenseProblem, pad_to
 from ..plan.tensor import (
     SolveCarry,
+    _pipeline_cold_impl,
+    _pipeline_warm_impl,
     _record_sweeps,
     _warm_repair,
     carry_from_assignment,
@@ -52,11 +54,67 @@ except AttributeError:  # older jax (e.g. 0.4.x)
     from jax.experimental.shard_map import shard_map as _shard_map
 
 __all__ = ["make_mesh", "make_mesh_2d", "make_hybrid_mesh",
-           "slice_major_order", "solve_dense_sharded",
-           "pad_partitions", "pad_nodes"]
+           "make_mesh_auto", "mesh_shape_for", "slice_major_order",
+           "solve_dense_sharded", "solve_pipeline_sharded",
+           "pad_partitions", "pad_nodes", "SOLVER_IN_LAYOUT",
+           "WARM_EXTRA_LAYOUT", "layout_specs"]
 
 PARTITION_AXIS = "parts"
 NODE_AXIS = "nodes"
+
+# --- declarative shard layouts ----------------------------------------------
+#
+# THE one table of how solver operands lay out on a mesh: each entry is
+# (operand name, "parts" = sharded over the partition axis | "replicated").
+# Every shard_map dispatch here derives its in_specs from these rows, and
+# the shape audit (analysis/shape_audit.py) builds its sharded contracts
+# from the SAME table — so the audited layout and the dispatched layout
+# cannot drift apart.  The node axis of a 2-D mesh never appears in the
+# specs: [N]-shaped operands stay REPLICATED along it by design (see the
+# module docstring) and the [P, N] splits happen inside solve_dense.
+
+SOLVER_IN_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("prev", "parts"),
+    ("pweights", "parts"),
+    ("nweights", "replicated"),
+    ("valid", "replicated"),
+    ("stickiness", "parts"),
+    ("gids", "replicated"),
+    ("gid_valid", "replicated"),
+)
+WARM_EXTRA_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("dirty", "parts"),
+    ("carry_used", "replicated"),
+)
+# Pipeline outputs: assign + the diff/pack tensors are row-wise in P
+# (shardable with zero collectives); the carry tables and scalars are
+# psum'd/globally-agreed inside the body, hence replicated.
+PIPELINE_COLD_OUT_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("assign", "parts"), ("sweeps", "replicated"),
+    ("prices", "replicated"), ("used", "replicated"),
+    ("d_nodes", "parts"), ("d_states", "parts"), ("d_ops", "parts"),
+    ("packed", "parts"), ("counts", "parts"),
+)
+PIPELINE_WARM_OUT_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("assign", "parts"), ("prices", "replicated"),
+    ("used", "replicated"), ("ok", "replicated"),
+    ("d_nodes", "parts"), ("d_states", "parts"), ("d_ops", "parts"),
+    ("packed", "parts"), ("counts", "parts"),
+)
+
+
+def layout_specs(layout: tuple) -> tuple:
+    """Rows of a layout table -> PartitionSpecs for shard_map."""
+    specs = []
+    for name, kind in layout:
+        if kind == "parts":
+            specs.append(P(PARTITION_AXIS))
+        elif kind == "replicated":
+            specs.append(P())
+        else:
+            raise ValueError(
+                f"layout row {name!r}: unknown kind {kind!r}")
+    return tuple(specs)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -133,6 +191,85 @@ def make_mesh_2d(
         raise ValueError(f"need {need} devices, have {len(devs)}")
     arr = np.asarray(devs[:need]).reshape(parts_shards, node_shards)
     return Mesh(arr, (PARTITION_AXIS, NODE_AXIS))
+
+
+# Per-axis shard caps for mesh_shape_for.  The per-shard [P_l, N_l]
+# block is P*N/n_devices for EVERY factorization (memory cannot prefer
+# one), so the factorization is chosen by per-AXIS extents instead:
+# _PART_CAP is the partition rows one chip handles comfortably
+# (calibrated: 100k x 10k solves on one v5e, so 128k rows per shard is
+# conservative), _NODE_CAP the column width past which the ">> 10k
+# nodes" guidance (module docstring) wants the node axis engaged — [N]
+# replicated vectors and psums stay kilobytes-to-small below it.
+_PART_CAP = 1 << 17  # 131072 partition rows per shard
+_NODE_CAP = 1 << 14  # 16384 node columns per shard
+
+
+def mesh_shape_for(
+    n_devices: int,
+    p: int,
+    n: int,
+    *,
+    part_cap: int = _PART_CAP,
+    node_cap: int = _NODE_CAP,
+) -> tuple[int, int]:
+    """(parts_shards, node_shards) for ANY device count — the mesh
+    factorization rule that replaces the hand-picked 8-chip meshes.
+
+    Pure and deterministic (unit-testable without devices).  Preference
+    order: the partition axis (its only collectives are [N]-sized
+    psums; the node axis adds per-round all_gathers of row stats), so
+    among factorizations keeping both per-shard axis extents within
+    their caps the fewest node shards wins — small problems on any
+    fleet resolve to the plain 1-D partition mesh.  When no divisor of
+    ``n_devices`` fits both caps (beyond-fleet problems: 1M x 1M on 8
+    chips), the factorization minimizing the worst RELATIVE axis
+    overload is returned, ties toward fewer node shards — both axes
+    degrade together instead of one exploding.
+    parts_shards * node_shards == n_devices always: every chip works.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if p < 0 or n < 0:
+        raise ValueError(f"negative problem dims ({p}, {n})")
+    divisors = [d for d in range(1, n_devices + 1) if n_devices % d == 0]
+
+    def overload(node_shards: int) -> float:
+        parts = n_devices // node_shards
+        p_l = -(-max(p, 1) // parts)
+        n_l = -(-max(n, 1) // node_shards)
+        return max(p_l / part_cap, n_l / node_cap)
+
+    fitting = [d for d in divisors if overload(d) <= 1.0]
+    if fitting:
+        best = fitting[0]  # smallest node_shards: prefer the parts axis
+    else:
+        best = min(divisors, key=lambda d: (overload(d), d))
+    return n_devices // best, best
+
+
+def make_mesh_auto(
+    p: int,
+    n: int,
+    devices: Optional[list] = None,
+    slice_ids: Optional[list] = None,
+) -> Mesh:
+    """Problem-shaped mesh over ALL available devices: 1-D partition
+    mesh (slice-major ordered across slices, like make_hybrid_mesh)
+    while a partition-only split fits, 2-D (parts x nodes) beyond that
+    — the beyond-8-chip entry point: 4, 8, 64 or 256 chips all resolve
+    to a working factorization with no hand-tuned mesh shape."""
+    if devices is None:
+        devices = list(jax.devices())
+    if slice_ids is None:
+        slice_ids = [getattr(d, "slice_index", 0) for d in devices]
+    if len(set(slice_ids)) > 1:
+        order = slice_major_order(slice_ids)
+        devices = [devices[i] for i in order]
+    parts, nodes = mesh_shape_for(len(devices), p, n)
+    if nodes == 1:
+        return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+    return make_mesh_2d(parts, nodes, devices=devices)
 
 
 def pad_partitions(arr: np.ndarray, multiple: int,
@@ -311,8 +448,8 @@ def solve_dense_sharded(
             axis_name=PARTITION_AXIS, node_axis=node_axis,
             node_shards=node_shards, fused_score=fused_score)
         sm_w = partial(_shard_map, body_w, mesh=mesh,
-                       in_specs=(shard, shard, rep, rep, shard, rep, rep,
-                                 shard, rep),
+                       in_specs=layout_specs(
+                           SOLVER_IN_LAYOUT + WARM_EXTRA_LAYOUT),
                        out_specs=(shard, rep, rep))
         fn_w = _build_checked(sm_w, checked_ok)
         with rec.span("plan.solve.attempt", warm=True, sharded=True), \
@@ -358,7 +495,7 @@ def solve_dense_sharded(
         fused_score=fused_score,
     )
     sm = partial(_shard_map, body, mesh=mesh,
-                 in_specs=(shard, shard, rep, rep, shard, rep, rep),
+                 in_specs=layout_specs(SOLVER_IN_LAYOUT),
                  out_specs=shard)
     fn = _build_checked(sm, checked_ok)
     # Same dispatch-time constant-upload exemption as the warm path.
@@ -372,6 +509,185 @@ def solve_dense_sharded(
             assign, np.asarray(pweights, np.float32),
             np.asarray(nweights, np.float32))
     return assign
+
+
+@lru_cache(maxsize=64)
+def _pipeline_sharded_fn(
+    mesh: Mesh,
+    constraints: tuple,
+    rules: tuple,
+    max_iterations: int,
+    fused_score: str,
+    favor_min_nodes: bool,
+    node_axis: Optional[str],
+    node_shards: int,
+    warm: bool,
+):
+    """Build-and-jit one sharded pipeline dispatch, memoized on (mesh,
+    statics).  The eager shard_map spelling recompiles its sub-programs
+    on EVERY call (the builder closures are fresh objects, so nothing
+    keys the cache); jitting the built fn and caching it here makes
+    repeat dispatches hit the jit cache — the bounded-compilation
+    contract the retrace budget (analysis/retrace.py, sharded.pipeline)
+    pins."""
+    if warm:
+        pipe_body = partial(
+            _pipeline_warm_impl,
+            constraints=constraints, rules=rules,
+            axis_name=PARTITION_AXIS, node_axis=node_axis,
+            node_shards=node_shards, fused_score=fused_score,
+            favor_min_nodes=favor_min_nodes)
+        in_layout = SOLVER_IN_LAYOUT + WARM_EXTRA_LAYOUT
+        out_layout = PIPELINE_WARM_OUT_LAYOUT
+    else:
+        pipe_body = partial(
+            _pipeline_cold_impl,
+            constraints=constraints, rules=rules,
+            axis_name=PARTITION_AXIS, max_iterations=max_iterations,
+            node_axis=node_axis, node_shards=node_shards,
+            fused_score=fused_score, favor_min_nodes=favor_min_nodes)
+        in_layout = SOLVER_IN_LAYOUT
+        out_layout = PIPELINE_COLD_OUT_LAYOUT
+    sm = partial(_shard_map, pipe_body, mesh=mesh,
+                 in_specs=layout_specs(in_layout),
+                 out_specs=layout_specs(out_layout))
+    return jax.jit(_build_checked(sm, False))
+
+
+def solve_pipeline_sharded(
+    mesh: Mesh,
+    prev: np.ndarray,
+    pweights: np.ndarray,
+    nweights: np.ndarray,
+    valid: np.ndarray,
+    stickiness: np.ndarray,
+    gids: np.ndarray,
+    gid_valid: np.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    max_iterations: int = 10,
+    fused_score: Optional[str] = None,
+    favor_min_nodes: bool = False,
+    dirty: Optional[np.ndarray] = None,
+    carry: Optional[SolveCarry] = None,
+    warm_only: bool = False,
+):
+    """The fused plan pipeline (solve -> diff -> pack) under shard_map.
+
+    The diff and the decode pack are row-wise in P — they shard over the
+    partition axis with ZERO additional collectives, so the pipeline
+    scales exactly as far as the solve does (any mesh mesh_shape_for /
+    make_mesh_auto produces, 1-D or 2-D, beyond the fixed 8-chip
+    layouts).  Returns (assign, SolveCarry, (d_nodes, d_states, d_ops))
+    with padding stripped — the tuple PlannerSession.replan_with_moves
+    consumes — or None when ``warm_only`` and the repair declined.
+
+    With ``dirty`` + ``carry`` the warm one-sweep repair runs first,
+    accepted under the solve_dense_warm contract; declined repairs fall
+    through to the cold fixpoint unless ``warm_only``.  The replication
+    checker stays off for the pipeline bodies: the psum'd carry tables
+    and globally-agreed scalars come back through replicated out_specs
+    the per-op vma walk cannot see through (same class of disable as the
+    2-D/fused paths above).
+    """
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_shards = axes[PARTITION_AXIS]
+    node_shards = axes.get(NODE_AXIS, 1)
+    node_axis = NODE_AXIS if node_shards > 1 else None
+    p_orig = prev.shape[0]
+    n_orig = np.asarray(nweights).shape[-1]
+    from ..plan import tensor as _tensor
+
+    _tensor._check_tier_band_scale(
+        prev, pweights, nweights, valid, stickiness, constraints, rules)
+    shard_p = -(-prev.shape[0] // n_shards)
+    shard_n = -(-n_orig // node_shards)
+    if fused_score is None:
+        fused_score = _tensor.resolve_default_fused_score(shard_p, shard_n)
+    else:
+        fused_score = _tensor.resolve_fused_score(
+            fused_score, shard_p, shard_n)
+
+    prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
+    pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
+    st_p = pad_partitions(np.asarray(stickiness), n_shards, 0.0)
+    nw_p = np.asarray(nweights)
+    valid_p = np.asarray(valid)
+    gids_p = np.asarray(gids)
+    gv_p = np.asarray(gid_valid)
+    if node_shards > 1:
+        nw_p = pad_nodes(nw_p, node_shards, 1.0)
+        valid_p = pad_nodes(valid_p, node_shards, False)
+        gids_p = pad_nodes(gids_p, node_shards, -1)
+        gv_p = pad_nodes(gv_p, node_shards, False)
+
+    shard = P(PARTITION_AXIS)
+    device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    dev_args = tuple(
+        device_put(jnp.asarray(a), spec)
+        for a, spec in zip(
+            (prev_p, pw_p, nw_p, valid_p, st_p, gids_p, gv_p),
+            layout_specs(SOLVER_IN_LAYOUT)))
+
+    rec = get_recorder()
+
+    def strip(out, new_used, darrs):
+        # The padded run's prices/packed ride along unused: the carry is
+        # rebuilt off the node-stripped used table, and the session's
+        # decode runs off ``current``/``proposed``, not the batch.
+        assign = np.asarray(out)[:p_orig]
+        used = jnp.asarray(np.asarray(new_used)[:, :n_orig])
+        carry_out = SolveCarry(
+            prices=jnp.sum(used, axis=0), assign=jnp.asarray(assign),
+            used=used)
+        d_nodes, d_states, d_ops = (np.asarray(a)[:p_orig] for a in darrs)
+        return assign, carry_out, (d_nodes, d_states, d_ops)
+
+    if dirty is not None and carry is not None:
+        dirty_p = pad_partitions(np.asarray(dirty, bool), n_shards, True)
+        cu = np.asarray(carry.used, np.float32)
+        if node_shards > 1:
+            cu = pad_nodes(cu, node_shards, 0.0)
+        rec.observe("plan.solve.dirty_fraction",
+                    float(np.asarray(dirty, bool).mean())
+                    if np.asarray(dirty).size else 0.0)
+        fn_w = _pipeline_sharded_fn(
+            mesh, constraints, rules, max_iterations, fused_score,
+            favor_min_nodes, node_axis, node_shards, warm=True)
+        t0 = rec.now()
+        with rec.span("plan.pipeline.dispatch", warm=True, sharded=True), \
+                _obs_device.entry("sharded.pipeline"):
+            # Same dispatch-time constant-upload exemption as
+            # solve_dense_sharded's paths.
+            with jax.transfer_guard("allow"):
+                (out, prices, new_used, ok, d_nodes, d_states, d_ops,
+                 packed, counts) = fn_w(
+                    *dev_args,
+                    device_put(jnp.asarray(dirty_p), shard),
+                    device_put(jnp.asarray(cu), P()))
+            accepted = bool(ok)
+        rec.observe("plan.pipeline.dispatch_s", rec.now() - t0)
+        if accepted:
+            _record_sweeps(1)
+            rec.set_attr("warm", True)
+            return strip(out, new_used, (d_nodes, d_states, d_ops))
+        rec.count("plan.solve.warm_fallback")
+        rec.count("plan.solve.sweeps", 1)  # the executed repair pass
+        if warm_only:
+            return None
+
+    fn = _pipeline_sharded_fn(
+        mesh, constraints, rules, max_iterations, fused_score,
+        favor_min_nodes, node_axis, node_shards, warm=False)
+    t0 = rec.now()
+    with rec.span("plan.pipeline.dispatch", sharded=True), \
+            jax.transfer_guard("allow"), \
+            _obs_device.entry("sharded.pipeline"):
+        (out, sweeps, prices, new_used, d_nodes, d_states, d_ops,
+         packed, counts) = fn(*dev_args)
+    rec.observe("plan.pipeline.dispatch_s", rec.now() - t0)
+    _record_sweeps(sweeps)
+    return strip(out, new_used, (d_nodes, d_states, d_ops))
 
 
 def solve_problem_sharded(

@@ -82,6 +82,20 @@ def plan_next_map(
                 prev_map, partitions_to_assign, nodes_all,
                 nodes_to_remove, nodes_to_add, model, opts)
         if backend == "tpu":
+            if opts.fused_pipeline:
+                # Fused fast path: one jitted encode→solve→diff→pack
+                # dispatch (plan/tensor.plan_pipeline); the map is
+                # bit-identical to the staged path's.  The on-device
+                # move diff rides along — callers that want it call
+                # plan_pipeline directly (this signature returns only
+                # (map, warnings)).
+                from .tensor import plan_pipeline  # deferred: imports jax
+
+                next_map, warnings, _ = plan_pipeline(
+                    prev_map, partitions_to_assign, nodes_all,
+                    nodes_to_remove, nodes_to_add, model, opts,
+                    timer=timer, want_moves=False)
+                return next_map, warnings
             from .tensor import plan_next_map_tpu  # deferred: imports jax
 
             return plan_next_map_tpu(

@@ -504,6 +504,170 @@ class PlannerSession:
         self.remove_nodes(list(dead_nodes))
         return self.replan()
 
+    def replan_with_moves(
+        self, favor_min_nodes: bool = False
+    ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Fused replan: solve + move diff + decode pack in ONE donated
+        device dispatch (the plan pipeline, ROADMAP item 3).
+
+        Semantically ``replan()`` followed by ``moves(favor_min_nodes)``
+        — bit-identical proposed assignment AND move arrays, pinned by
+        tests — but the steady-state delta replan pays a single device
+        round trip: the warm one-sweep repair, the prev-vs-next diff and
+        the decode pack run inside one jitted program with the previous
+        assignment and consumed carry donated into the outputs.  Falls
+        back exactly like replan() (cold pipeline on carry miss/decline/
+        audit violation; staged solve on engine failure).  Stores
+        ``proposed`` and the pending carry like replan()."""
+        from ..obs import get_recorder
+        from .tensor import maybe_validate, resolve_default_fused_score
+
+        prob = self._problem
+        rules = tuple(tuple(prob.rules.get(si, ())) for si in range(prob.S))
+        constraints = tuple(int(c) for c in prob.constraints)
+        if prob.P == 0 or prob.N == 0 or prob.S == 0:
+            self.proposed = self.current.copy()
+            L = 2 * prob.S * max(self.current.shape[2], 1)
+            empty = np.full((prob.P, L), -1, np.int32)
+            return self.proposed, (empty, empty.copy(), empty.copy())
+
+        rec = get_recorder()
+        rec.count("plan.pipeline.calls")
+        iters = max(int(self.opts.max_iterations), 1)
+        mode = resolve_default_fused_score(prob.P, prob.N)
+
+        carry, dirty_base = self._carries.consume(self._ckey, self.current)
+        if carry is None:
+            rec.count("plan.solve.carry_miss")
+        result = None
+        if carry is not None:
+            from .carry import effective_dirty
+
+            dirty = effective_dirty(dirty_base, self.current,
+                                    prob.constraints)
+            if self._capacity_shrank(carry, dirty):
+                rec.count("plan.solve.carry_miss")
+            else:
+                result = self._warm_pipeline(
+                    carry, dirty, constraints, rules, mode,
+                    favor_min_nodes)
+                if result is not None and \
+                        self._audit_gate(prob, result[0]):
+                    rec.count("plan.solve.warm_fallback")
+                    result = None
+                if result is not None:
+                    rec.count("plan.solve.carry_hit")
+                    rec.count("plan.pipeline.warm")
+
+        if result is None:
+            result = self._cold_pipeline(constraints, rules, iters, mode,
+                                         favor_min_nodes)
+        assign, new_carry, darrs = result
+        maybe_validate(prob, assign, self.opts.validate_assignment,
+                       "PlannerSession.replan_with_moves")
+        self.proposed = assign
+        self._carries.store_pending(self._ckey, new_carry)
+        return assign, darrs
+
+    def _warm_pipeline(
+        self, carry: "SolveCarry", dirty: np.ndarray, constraints: tuple,
+        rules: tuple, mode: str, favor_min_nodes: bool,
+    ) -> "Optional[tuple]":
+        """One warm pipeline dispatch; None on decline/failure.
+        Returns (assign, next_carry, (d_nodes, d_states, d_ops))."""
+        import jax.numpy as jnp
+
+        from . import tensor as _tensor
+        from ..obs import device as _obs_device
+        from ..obs import get_recorder
+        from .tensor import SolveCarry
+
+        prob = self._problem
+        rec = get_recorder()
+        dirty_np = np.asarray(dirty, bool)
+        try:
+            if self.mesh is not None:
+                # solve_pipeline_sharded records dirty_fraction itself
+                # (like solve_dense_sharded on the staged path).
+                from ..parallel.sharded import solve_pipeline_sharded
+
+                return solve_pipeline_sharded(
+                    self.mesh, self.current, prob.partition_weights,
+                    prob.node_weights, prob.valid_node, prob.stickiness,
+                    prob.gids, prob.gid_valid, constraints, rules,
+                    favor_min_nodes=favor_min_nodes, dirty=dirty_np,
+                    carry=carry, warm_only=True)
+            rec.observe("plan.solve.dirty_fraction",
+                        float(dirty_np.mean()) if dirty_np.size else 0.0)
+            t0 = rec.now()
+            with rec.span("plan.pipeline.dispatch", warm=True,
+                          engine=mode), \
+                    _obs_device.entry("pipeline.warm"):
+                (out, prices, used, ok, d_nodes, d_states, d_ops,
+                 _packed, _counts) = _tensor._pipeline_warm_donating(
+                    jnp.asarray(self.current),
+                    jnp.asarray(prob.partition_weights),
+                    jnp.asarray(prob.node_weights),
+                    jnp.asarray(prob.valid_node),
+                    jnp.asarray(prob.stickiness),
+                    jnp.asarray(prob.gids),
+                    jnp.asarray(prob.gid_valid),
+                    jnp.asarray(dirty_np),
+                    jnp.asarray(carry.used),
+                    constraints, rules, fused_score=mode,
+                    favor_min_nodes=favor_min_nodes)
+                accepted = bool(ok)
+            rec.observe("plan.pipeline.dispatch_s", rec.now() - t0)
+            if not accepted:
+                rec.count("plan.solve.warm_fallback")
+                rec.count("plan.solve.sweeps", 1)  # the spent repair
+                return None
+            _tensor._record_sweeps(1)
+            rec.set_attr("warm", True)
+            return (np.asarray(out),
+                    SolveCarry(prices=prices, assign=out, used=used),
+                    (np.asarray(d_nodes), np.asarray(d_states),
+                     np.asarray(d_ops)))
+        except (ValueError, TypeError):
+            raise  # deterministic input errors: same on the cold path
+        except Exception as e:
+            import warnings as _warnings
+
+            first = (str(e).splitlines() or [""])[0][:200]
+            _warnings.warn(
+                f"blance_tpu PlannerSession.replan_with_moves: warm "
+                f"pipeline failed ({type(e).__name__}: {first}); falling "
+                f"back to a cold solve", UserWarning, stacklevel=3)
+            rec.count("plan.solve.warm_fallback")
+            return None
+
+    def _cold_pipeline(
+        self, constraints: tuple, rules: tuple, iters: int, mode: str,
+        favor_min_nodes: bool,
+    ) -> tuple:
+        """Cold pipeline dispatch (mesh-sharded when the session has a
+        mesh); returns (assign, next_carry, diff arrays)."""
+        from . import tensor as _tensor
+
+        prob = self._problem
+        if self.mesh is not None:
+            from ..parallel.sharded import solve_pipeline_sharded
+
+            return solve_pipeline_sharded(
+                self.mesh, self.current, prob.partition_weights,
+                prob.node_weights, prob.valid_node, prob.stickiness,
+                prob.gids, prob.gid_valid, constraints, rules,
+                max_iterations=iters, favor_min_nodes=favor_min_nodes)
+        assign, _sweeps, new_carry, darrs, _packed = \
+            _tensor._dispatch_pipeline_cold(
+                self.current, prob.partition_weights, prob.node_weights,
+                prob.valid_node, prob.stickiness, prob.gids,
+                prob.gid_valid, constraints, rules, max_iterations=iters,
+                fused_score=mode,
+                allow_fallback=_tensor._FUSED_SCORE_DEFAULT == "auto",
+                favor_min_nodes=favor_min_nodes, entry="pipeline.cold")
+        return assign, new_carry, darrs
+
     def moves(
         self, favor_min_nodes: bool = False
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

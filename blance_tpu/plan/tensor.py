@@ -61,9 +61,10 @@ from ..ops.score_fused import (
     score_at_columns,
 )
 
-__all__ = ["plan_next_map_tpu", "solve_dense", "solve_dense_converged",
-           "solve_converged_resilient", "solve_dense_warm", "SolveCarry",
-           "carry_from_assignment", "check_assignment", "maybe_validate"]
+__all__ = ["plan_next_map_tpu", "plan_pipeline", "solve_dense",
+           "solve_dense_converged", "solve_converged_resilient",
+           "solve_dense_warm", "SolveCarry", "carry_from_assignment",
+           "check_assignment", "maybe_validate"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -1804,6 +1805,354 @@ def solve_dense_warm(
         rec.set_attr("warm", True)
     return np.asarray(out), SolveCarry(
         prices=jnp.sum(new_used, axis=0), assign=out, used=new_used)
+
+
+# --- fused single-dispatch plan pipeline ------------------------------------
+#
+# ROADMAP item 3: at the north star the device solve is ~1/3 of
+# end-to-end wall-clock — host encode/decode and the separate move-diff
+# dispatch own the rest.  These impls chain solve -> on-device move diff
+# -> on-device decode pack into ONE jitted program, so a plan round trip
+# pays one dispatch and no intermediate host transfer: the solver output
+# feeds the diff and the pack as device values inside the same trace.
+# Buffer donation (prev, and the warm path's carry table) lets XLA alias
+# the inputs into the same-shaped outputs (assign/packed are prev-shaped,
+# new_used is carry_used-shaped), so the steady-state replan loop
+# allocates no fresh [P, S, R] buffers.  Host work shrinks to the
+# id->name materialization (decode_assignment's gather + NextMoves
+# lists), which is irreducibly string-typed.
+
+
+def _pipeline_cold_impl(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    max_iterations: int = 10,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
+    fused_score: str = "off",
+    favor_min_nodes: bool = False,
+    carry_used: Optional[jnp.ndarray] = None,
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, ...]:
+    """Cold pipeline body: converged solve + diff(prev, out) + pack.
+
+    Returns (assign, sweeps, prices, used, d_nodes, d_states, d_ops,
+    packed, counts).  ``prices``/``used`` are the next SolveCarry's
+    tables, computed with the carry builder's exact ops so the packaged
+    carry is bitwise what carry_from_assignment would build —  without
+    a second dispatch.  The solve is the UNCHANGED converged fixpoint
+    trace, so ``assign`` is bit-identical to the staged path's.
+    """
+    from ..core.encode import pack_assignment_core
+    from ..moves.batch import diff_assignments
+
+    out, sweeps = _solve_dense_converged_impl(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        constraints, rules, axis_name, max_iterations, node_axis,
+        node_shards, fused_score, carry_used, p_real)
+    used = _used_by_state(out, pweights, nweights.shape[0], prev.shape[1],
+                          axis_name)
+    prices = jnp.sum(used, axis=0)
+    d_nodes, d_states, d_ops = diff_assignments(
+        prev, out, favor_min_nodes=favor_min_nodes)
+    packed, counts = pack_assignment_core(out)
+    return (out, sweeps, prices, used, d_nodes, d_states, d_ops,
+            packed, counts)
+
+
+def _pipeline_warm_impl(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    dirty: jnp.ndarray,
+    carry_used: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
+    fused_score: str = "off",
+    favor_min_nodes: bool = False,
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, ...]:
+    """Warm pipeline body: one carry-seeded repair sweep (_warm_repair,
+    acceptance flags included) + diff + pack in the same program.
+
+    Returns (assign, prices, used, ok, d_nodes, d_states, d_ops,
+    packed, counts); ``ok`` False means the repair leaked and the
+    caller must run the cold pipeline — the diff/pack work is then
+    wasted, which is fine: declines are the rare path by design."""
+    from ..core.encode import pack_assignment_core
+    from ..moves.batch import diff_assignments
+
+    out, new_used, ok = _warm_repair(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        dirty, carry_used, constraints, rules, axis_name, node_axis,
+        node_shards, fused_score, p_real)
+    prices = jnp.sum(new_used, axis=0)
+    d_nodes, d_states, d_ops = diff_assignments(
+        prev, out, favor_min_nodes=favor_min_nodes)
+    packed, counts = pack_assignment_core(out)
+    return (out, prices, new_used, ok, d_nodes, d_states, d_ops,
+            packed, counts)
+
+
+_PIPE_COLD_STATICS = ("constraints", "rules", "axis_name",
+                      "max_iterations", "node_axis", "node_shards",
+                      "fused_score", "favor_min_nodes")
+_PIPE_WARM_STATICS = ("constraints", "rules", "axis_name", "node_axis",
+                      "node_shards", "fused_score", "favor_min_nodes")
+
+_pipeline_cold_jit = partial(
+    jax.jit, static_argnames=_PIPE_COLD_STATICS)(_pipeline_cold_impl)
+# Donation: prev aliases into the same-shaped assign/packed outputs; the
+# warm path additionally donates the consumed carry table (single-use by
+# contract — sessions replace theirs after every attempt).  Donation is
+# supported on every backend under the pinned jax (tests assert the
+# donated buffers really are invalidated), so there is no CPU split like
+# _warm_repair_donating's.
+_pipeline_cold_donating = jax.jit(
+    _pipeline_cold_impl, static_argnames=_PIPE_COLD_STATICS,
+    donate_argnames=("prev",))
+_pipeline_warm_jit = partial(
+    jax.jit, static_argnames=_PIPE_WARM_STATICS)(_pipeline_warm_impl)
+_pipeline_warm_donating = jax.jit(
+    _pipeline_warm_impl, static_argnames=_PIPE_WARM_STATICS,
+    donate_argnames=("prev", "carry_used"))
+
+
+def _seeded_beg_map(prev_map: PartitionMap,
+                    partitions_to_assign: PartitionMap) -> PartitionMap:
+    """The beginning state the planner actually diffs against: prev_map
+    entries where present, partitions_to_assign seeds elsewhere — the
+    same ``prev_map.get(p) or partitions_to_assign[p]`` rule
+    encode_problem fills prev[P, S, R] with."""
+    return {name: (prev_map.get(name) or partitions_to_assign[name])
+            for name in partitions_to_assign}
+
+
+def plan_pipeline(
+    prev_map: PartitionMap,
+    partitions_to_assign: PartitionMap,
+    nodes_all: list[str],
+    nodes_to_remove: Optional[list[str]],
+    nodes_to_add: Optional[list[str]],
+    model: PartitionModel,
+    opts: Optional[PlanOptions] = None,
+    timer=None,
+    *,
+    favor_min_nodes: bool = False,
+    want_moves: bool = True,
+):
+    """plan_next_map_tpu + the move diff in ONE device dispatch.
+
+    Returns (next_map, warnings, moves): the map and warnings are
+    bit-identical to ``plan_next_map_tpu``'s, and ``moves`` matches
+    ``moves.batch.calc_all_moves(seeded_beg, next_map, model,
+    favor_min_nodes)`` (the per-partition ordered op lists the
+    orchestrator consumes), where seeded_beg resolves missing prev
+    entries from partitions_to_assign exactly like the encoder.  The
+    encode stays host (string interning), then encode->solve->diff->
+    decode-pack run as one jitted, buffer-donated program — no
+    intermediate host transfer between solve and diff, and decode's
+    host share is only the id->name gather.
+
+    Caveat shared with PlannerSession.moves(): partitions whose
+    beginning state holds one node in several states diff through the
+    dense one-state-per-node encoding (calc_all_moves's irregular-host
+    fallback does not apply); the solver's own outputs never do that.
+
+    Engine/runtime failures degrade to the staged path
+    (plan_next_map_tpu + device diff), counted as
+    ``plan.pipeline.fallback`` — the pipeline is a fast path, never a
+    new failure mode.
+
+    ``want_moves=False`` skips the host move materialization (and the
+    fallback paths' diff entirely), returning ``{}`` as the third
+    element — for callers that only want the map riding the fused
+    dispatch (plan_next_map's ``fused_pipeline`` option)."""
+    from ..moves.batch import calc_all_moves
+    from ..utils.trace import PhaseTimer
+
+    opts = opts or PlanOptions()
+    timer = timer if timer is not None else PhaseTimer()
+    rec = get_recorder()
+    if not _tpu_supported(opts):
+        # Exact-path fallback keeps custom placement hooks; the move
+        # diff still runs on device against the dense maps.
+        next_map, warnings = plan_next_map_tpu(
+            prev_map, partitions_to_assign, nodes_all, nodes_to_remove,
+            nodes_to_add, model, opts, timer=timer)
+        moves = calc_all_moves(
+            _seeded_beg_map(prev_map, partitions_to_assign), next_map,
+            model, favor_min_nodes) if want_moves else {}
+        return next_map, warnings, moves
+    del nodes_to_add
+
+    with rec.span("plan.pipeline", partitions=len(partitions_to_assign),
+                  nodes=len(nodes_all)):
+        rec.count("plan.pipeline.calls")
+        with phase_span("plan.encode", timer=timer):
+            problem = encode_problem(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, model, opts)
+        if problem.P == 0 or problem.N == 0 or problem.S == 0:
+            next_map, warnings = decode_assignment(
+                problem,
+                np.full((problem.P, problem.S, max(problem.R, 1)), -1,
+                        np.int32),
+                partitions_to_assign, nodes_to_remove)
+            return next_map, warnings, {n: [] for n in problem.partitions}
+
+        rules = tuple(
+            tuple(problem.rules.get(si, ())) for si in range(problem.S))
+        constraints = tuple(int(c) for c in problem.constraints)
+
+        prev_a = problem.prev
+        pw_a = problem.partition_weights
+        nw_a = problem.node_weights
+        valid_a = problem.valid_node
+        stick_a = problem.stickiness
+        gids_a = problem.gids
+        gv_a = problem.gid_valid
+        solve_p, solve_n = problem.P, problem.N
+        if opts.shape_bucketing:
+            from ..core.encode import bucket_size, pad_problem_arrays
+
+            solve_p = bucket_size(problem.P)
+            solve_n = bucket_size(problem.N)
+            (prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a) = \
+                pad_problem_arrays(prev_a, pw_a, nw_a, valid_a, stick_a,
+                                   gids_a, gv_a, solve_p, solve_n)
+        _check_tier_band_scale(prev_a, pw_a, nw_a, valid_a, stick_a,
+                               constraints, rules)
+        mode = resolve_default_fused_score(solve_p, solve_n)
+
+        try:
+            res = _dispatch_pipeline_cold(
+                prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
+                constraints, rules,
+                max_iterations=max(int(opts.max_iterations), 1),
+                fused_score=mode,
+                allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
+                favor_min_nodes=favor_min_nodes,
+                entry=("solve_dense.bucketed" if opts.shape_bucketing
+                       else "pipeline.cold"),
+                timer=timer,
+                p_real=(jax.device_put(np.float32(problem.P))
+                        if opts.shape_bucketing else None))
+        except (ValueError, TypeError):
+            raise  # deterministic input errors: same on the staged path
+        except Exception as e:
+            import warnings as _warnings
+
+            first = (str(e).splitlines() or [""])[0][:200]
+            _warnings.warn(
+                f"blance_tpu plan_pipeline: fused dispatch failed "
+                f"({type(e).__name__}: {first}); degrading to the staged "
+                f"path", UserWarning, stacklevel=2)
+            rec.count("plan.pipeline.fallback")
+            next_map, warnings = plan_next_map_tpu(
+                prev_map, partitions_to_assign, nodes_all,
+                nodes_to_remove, None, model, opts, timer=timer)
+            moves = calc_all_moves(
+                _seeded_beg_map(prev_map, partitions_to_assign),
+                next_map, model, favor_min_nodes) if want_moves else {}
+            return next_map, warnings, moves
+
+        assign, _sweeps, _carry, (d_nodes, d_states, d_ops), \
+            (packed, counts) = res
+        assign = assign[:problem.P]
+        maybe_validate(problem, assign, opts.validate_assignment,
+                       "plan_pipeline")
+        with phase_span("plan.decode", timer=timer):
+            next_map, warnings = decode_assignment(
+                problem, assign, partitions_to_assign, nodes_to_remove,
+                packed=packed[:problem.P], counts=counts[:problem.P])
+        if not want_moves:
+            return next_map, warnings, {}
+        with phase_span("plan.pipeline.materialize", timer=timer):
+            from ..moves.batch import moves_from_arrays
+
+            moves = moves_from_arrays(
+                problem.partitions, problem.states, problem.nodes,
+                d_nodes[:problem.P], d_states[:problem.P],
+                d_ops[:problem.P])
+        return next_map, warnings, moves
+
+
+def _dispatch_pipeline_cold(
+    prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
+    constraints: tuple, rules: tuple, *, max_iterations: int,
+    fused_score: str, allow_fallback: bool, favor_min_nodes: bool,
+    entry: str, timer=None, carry_used=None, p_real=None, donate=True,
+):
+    """One cold pipeline dispatch with the engine-failure degradation of
+    solve_converged_resilient (retry once on the opposite engine when
+    the mode came from "auto").  Returns (assign_np, sweeps,
+    SolveCarry, (d_nodes, d_states, d_ops) np, (packed, counts) np) —
+    everything off-device exactly once, at the end."""
+    import warnings as _warnings
+
+    rec = get_recorder()
+
+    def run(m: str):
+        impl = _pipeline_cold_donating if donate else _pipeline_cold_jit
+        dev_prev = jnp.asarray(prev_a)
+        t0 = rec.now()
+        with phase_span("plan.pipeline.dispatch", timer=timer,
+                        engine=m), \
+                _device.entry(entry):
+            out = impl(
+                dev_prev, jnp.asarray(pw_a), jnp.asarray(nw_a),
+                jnp.asarray(valid_a), jnp.asarray(stick_a),
+                jnp.asarray(gids_a), jnp.asarray(gv_a),
+                constraints, rules, max_iterations=max_iterations,
+                fused_score=m, favor_min_nodes=favor_min_nodes,
+                carry_used=carry_used, p_real=p_real)
+            (assign, sweeps, prices, used, d_nodes, d_states, d_ops,
+             packed, counts) = out
+            # One boundary crossing for the whole pipeline: everything
+            # below is host-side materialization.
+            assign_np = np.asarray(assign)
+        rec.observe("plan.pipeline.dispatch_s", rec.now() - t0)
+        _record_sweeps(sweeps)
+        carry = SolveCarry(prices=prices, assign=assign, used=used)
+        return (assign_np, sweeps, carry,
+                (np.asarray(d_nodes), np.asarray(d_states),
+                 np.asarray(d_ops)),
+                (np.asarray(packed), np.asarray(counts)))
+
+    try:
+        return run(fused_score)
+    except (ValueError, TypeError):
+        raise
+    except Exception as e:
+        alt = {"off": "on", "on": "off"}.get(fused_score)
+        if not allow_fallback or alt is None or \
+                (alt == "on" and not pallas_available()):
+            raise
+        first = (str(e).splitlines() or [""])[0][:200]
+        _warnings.warn(
+            f"blance_tpu plan_pipeline: score engine {fused_score!r} "
+            f"failed to compile/run ({type(e).__name__}: {first}); "
+            f"retrying with {alt!r}", UserWarning, stacklevel=3)
+        rec.count("plan.engine_fallback")
+        if timer is not None:
+            timer.annotate("engine_fallback", f"-> {alt}")
+        return run(alt)
 
 
 def solve_converged_resilient(

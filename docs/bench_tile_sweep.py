@@ -1,83 +1,30 @@
-"""Fused-kernel tile sweep on real TPU hardware (BASELINE.md roofline).
+"""Deprecated shim: the tile sweep is now a first-class bench stage.
 
-Sweeps BLANCE_FUSED_TILE_P/N over aligned candidates at the north-star
-shape, one subprocess per combination (the tiles are read once at import
-— see ops/score_fused.py), timing the converged fused solve exactly like
-bench.py's bench_tpu.  Run only with a healthy device tunnel; each
-subprocess compiles (~40 s) then times RUNS solves.
-
-Usage: python docs/bench_tile_sweep.py [P] [N]
-Prints one JSON line per tile combination.
+Run ``python bench.py --tile-sweep [--tile-sweep-shape PxN]`` instead —
+it sweeps BOTH Pallas kernels' tiles (the in-kernel score AND the
+priced min2 reduction the warm repair rides), emits one parseable JSON
+artifact naming the winning combination, and degrades to interpret-mode
+smoke sizes on cpu-only hosts instead of requiring a device tunnel.
+This file forwards there so existing invocations keep working.
 """
 
-import json
 import os
 import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_CHILD = r"""
-import json, sys, time
-sys.path.insert(0, {repo!r})
-import numpy as np
-import bench
-import jax.numpy as jnp
-from blance_tpu.plan.tensor import solve_dense_converged
-from blance_tpu.ops import score_fused
-P, N = {P}, {N}
-args = bench.build_dense(P, N)
-(prev, pweights, nweights, valid, stickiness, gids, gid_valid,
- constraints, rules) = args
-dev = [jnp.asarray(a) for a in
-       (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
-def run():
-    out = solve_dense_converged(*dev, constraints, rules, fused_score="on")
-    np.asarray(out[:, 0, 0])  # force completion (axon block_until_ready quirk)
-    return out
-t0 = time.perf_counter(); run(); compile_s = time.perf_counter() - t0
-times = []
-for _ in range(4):
-    t0 = time.perf_counter(); run(); times.append(time.perf_counter() - t0)
-print(json.dumps({{
-    "tile_p": score_fused._TILE_P, "tile_n": score_fused._TILE_N,
-    "compile_s": round(compile_s, 1),
-    "solve_ms_min": round(min(times) * 1000, 2),
-    "solve_ms_runs": [round(t * 1000, 2) for t in times]}}))
-"""
 
-
-def main():
-    P = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    N = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
-    child = _CHILD.format(repo=REPO, P=P, N=N)
-    for tile_p in (128, 256, 512):
-        for tile_n in (1024, 2048, 4096):
-            env = dict(os.environ,
-                       BLANCE_FUSED_TILE_P=str(tile_p),
-                       BLANCE_FUSED_TILE_N=str(tile_n))
-            t0 = time.time()
-            try:
-                r = subprocess.run(
-                    [sys.executable, "-c", child], env=env, timeout=600,
-                    capture_output=True, text=True, check=True)
-                lines = r.stdout.strip().splitlines()
-                print(lines[-1] if lines else json.dumps(
-                    {"tile_p": tile_p, "tile_n": tile_n,
-                     "error": "no output"}), flush=True)
-            except subprocess.TimeoutExpired:
-                print(json.dumps({"tile_p": tile_p, "tile_n": tile_n,
-                                  "error": "timeout",
-                                  "elapsed_s": round(time.time() - t0)}),
-                      flush=True)
-            except subprocess.CalledProcessError as e:
-                err = (e.stderr or "").strip().splitlines()
-                print(json.dumps({
-                    "tile_p": tile_p, "tile_n": tile_n,
-                    "error": err[-1][-200:] if err else "failed"}),
-                    flush=True)
+def main() -> int:
+    args = [sys.executable, os.path.join(REPO, "bench.py"), "--tile-sweep"]
+    if len(sys.argv) > 2:
+        args += ["--tile-sweep-shape", f"{sys.argv[1]}x{sys.argv[2]}"]
+    elif len(sys.argv) > 1:
+        args += ["--tile-sweep-shape", f"{sys.argv[1]}x10000"]
+    print("docs/bench_tile_sweep.py is a shim; running:",
+          " ".join(args[1:]), file=sys.stderr)
+    return subprocess.call(args)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
