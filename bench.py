@@ -465,6 +465,59 @@ def bench_chaos(P=96, N=12, seed=7, fail_rate=0.3):
     return out
 
 
+def bench_simulate(seed=7, days=1.0):
+    """Continuous-rebalance simulator stage (docs/SIMULATOR.md): one
+    seeded mixed-fault scenario (daily churn, spot preemptions, a zone
+    flap, hot-tenant drift, overlapping deltas) replayed under the
+    DeterministicLoop virtual clock.  Reports the horizon SLO account —
+    time-weighted availability, churn vs the offline-optimal single
+    plan, p50/p95 per-incident convergence lag — plus the simulator's
+    own throughput headline: virtual sim-seconds per wall-second."""
+    from blance_tpu.testing.scenarios import mixed_week
+    from blance_tpu.testing.simulate import run_scenario
+
+    scn = mixed_week(seed, days=days)
+    r = run_scenario(scn)
+    lags = sorted(r.convergence_lags)
+
+    def pct(q):
+        if not lags:
+            return None
+        return round(lags[min(int(q * len(lags)), len(lags) - 1)], 3)
+
+    s = r.summary
+    out = {
+        "scenario": r.scenario, "seed": seed, "days": days,
+        "deltas": r.deltas, "rebalances": r.rebalances,
+        "superseded": r.superseded, "degraded": r.degraded,
+        "unconverged": r.unconverged,
+        "complete": r.complete,
+        "availability": round(s.availability, 6),
+        "time_weighted_availability": round(
+            s.time_weighted_availability, 6),
+        "violation_s": round(s.violation_s, 3),
+        "moves_executed": s.moves_executed,
+        "offline_min_moves": r.offline_min_moves,
+        "churn_vs_offline": (round(r.churn_vs_offline, 3)
+                             if r.churn_vs_offline is not None else None),
+        "convergence_lag_s": {"p50": pct(0.50), "p95": pct(0.95),
+                              "n": len(lags)},
+        "unscripted_drops": len(r.unscripted_drops),
+        "loop_steps": r.steps,
+        "wall_s": round(r.wall_s, 3),
+        "sim_s_per_wall_s": round(r.horizon_s / max(r.wall_s, 1e-9)),
+    }
+    log(f"[simulate {r.scenario} seed={seed} {days:g}d] "
+        f"complete={out['complete']} "
+        f"tw_avail={out['time_weighted_availability']} "
+        f"churn={out['churn_vs_offline']} "
+        f"lag p50/p95={out['convergence_lag_s']['p50']}/"
+        f"{out['convergence_lag_s']['p95']}s "
+        f"superseded={out['superseded']} "
+        f"{out['sim_s_per_wall_s']}x sim-s/wall-s")
+    return out
+
+
 def bench_costmodel(P=128, N=10, seed=5, fail_rate=0.25):
     """Cost-model stage: calibrate per-(node, op) EWMA move costs from
     the move-lifecycle spans of a chaos rebalance with a heterogeneous
@@ -1592,6 +1645,17 @@ def _run_benchmarks(smoke, backend_note=None):
         log(f"chaos stage failed ({type(e).__name__}: {first_line(e)})")
         detail["chaos_error"] = first_line(e)
     save_progress(detail, "chaos done")
+
+    # Simulator stage: a virtual day of closed-loop cluster life under
+    # mixed faults — the horizon SLO account (time-weighted
+    # availability, churn vs offline-optimal, convergence-lag
+    # percentiles) plus sim-seconds-per-wall-second.
+    try:
+        detail["simulate"] = bench_simulate()
+    except Exception as e:  # must not eat the solve numbers
+        log(f"simulate stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["simulate_error"] = first_line(e)
+    save_progress(detail, "simulate done")
 
     # Cost-model stage: EWMA (node, op) move costs calibrated from the
     # chaos run's move-lifecycle spans, scored predicted-vs-actual.

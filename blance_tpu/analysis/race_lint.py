@@ -104,6 +104,20 @@ SHARED_STATE: dict[str, frozenset[str]] = {
     # future async method on either class puts it under RACE001/002.
     "PlanService": frozenset({"_queue", "_task", "_closed", "_executor"}),
     "CarryCache": frozenset({"_entries", "_clock", "_bytes"}),
+    # -- continuous-rebalance controller (PR 10) ----------------------------
+    # RebalanceController's control state is touched by the app-facing
+    # sync surface (submit/stop_soon) and the controller task.  The
+    # discipline: every mutation sits in one no-await window (the sync
+    # helpers _take_pending/_apply_deltas/_adopt/_set_idle), the
+    # pending list is taken atomically with the wake-event clear, and
+    # the in-flight supersede decision re-reads _pending after every
+    # wake.  The supersede explorer scenario (analysis/schedule.py
+    # supersede_mid_rebalance) drives the windows dynamically.
+    "RebalanceController": frozenset({
+        "_pending", "_wake", "_idle", "_inflight", "_stopping",
+        "_task", "current", "_nodes", "_removing", "_failed",
+        "failures", "degraded_reports", "warnings",
+    }),
 }
 
 # Container mutators: a call to one of these on a shared attribute is a

@@ -564,6 +564,86 @@ async def _slo_gauges_under_chaos() -> dict[str, int]:
             "failed": slo.moves_failed}
 
 
+async def _supersede_mid_rebalance() -> dict[str, int]:
+    """The continuous-rebalance controller's supersede path: a second
+    cluster delta fired from INSIDE the first transition's assign
+    callback (structurally mid-flight) must cancel cleanly — no orphan
+    tasks after wind-down, no spurious failures — and the loop must
+    land on the SAME final map as a quiesced sequential run.  The
+    survivors here reduce to one node, so the sequential reference is
+    unique regardless of which prefix of the first transition executed
+    before the cancel.  Under most schedules the delta supersedes the
+    in-flight pass (``superseded == 1``); a schedule that lets the pass
+    finish first handles it as a second cycle — both must converge
+    identically."""
+    from ..obs import Recorder, use_recorder
+    from ..plan.api import plan_next_map
+    from ..rebalance import ClusterDelta, RebalanceController, count_moves
+
+    loop = asyncio.get_running_loop()
+    nodes = ["a", "b", "c"]
+    # Unlike the scripted-move scenarios above, this one PLANS — the
+    # model needs a real constraint (1 primary per partition), not the
+    # constraints=0 placeholder of _MODEL.
+    plan_model = {"primary": PartitionModelState(priority=0,
+                                                 constraints=1)}
+    beg = _pm({f"p{i}": {"primary": [nodes[i % 3]]} for i in range(4)})
+    with use_recorder(Recorder(clock=loop.time)):
+        fired = False
+        ctl: Optional[RebalanceController] = None
+
+        async def assign(stop_ch: Any, node: str, partitions: list[str],
+                         states: list[str], ops: list[str]) -> None:
+            nonlocal fired
+            assert ctl is not None
+            if not fired:
+                fired = True
+                ctl.submit(ClusterDelta(fail=("b",)))
+            await asyncio.sleep(0.01)
+
+        ctl = RebalanceController(plan_model, nodes, beg, assign,
+                                  debounce_s=0.001)
+        ctl.start()
+        ctl.submit(ClusterDelta(remove=("a",)))
+        final = await ctl.quiesce()
+        await ctl.stop()
+        for _ in range(3):  # let just-resolved movers finalize
+            await asyncio.sleep(0)
+        if ctl.pending_tasks():
+            raise InvariantViolation(
+                f"orphan tasks after cancel + wind-down: "
+                f"{[t.get_name() for t in ctl.pending_tasks()]}")
+        if ctl.failures:
+            raise InvariantViolation(
+                f"spurious failures on a fault-free supersede: "
+                f"{ctl.failures!r}")
+        if not fired:
+            raise InvariantViolation(
+                "the mid-flight delta never fired — scenario drifted "
+                "from the code under test")
+        # Sequential reference: quiesce delta 1 fully, then delta 2 —
+        # pure planning, schedule-independent (c is the only survivor,
+        # so the final map is unique).
+        m1, _w1 = plan_next_map(beg, beg, nodes, ["a"], [], plan_model,
+                                backend="greedy")
+        m2, _w2 = plan_next_map(m1, m1, nodes, ["a", "b"], [], plan_model,
+                                backend="greedy")
+        if count_moves(plan_model, m2, final) != 0:
+            raise InvariantViolation(
+                f"superseded run diverged from the quiesced sequential "
+                f"reference:\n  sequential: "
+                f"{ {k: v.nodes_by_state for k, v in m2.items()} !r}\n"
+                f"  superseded: "
+                f"{ {k: v.nodes_by_state for k, v in final.items()} !r}")
+        if any(p.nodes_by_state.get("primary") != ["c"]
+               for p in final.values()):
+            raise InvariantViolation(
+                f"final map incomplete on the sole survivor: "
+                f"{ {k: v.nodes_by_state for k, v in final.items()} !r}")
+    return {"superseded": ctl.superseded, "cycles": ctl.cycles,
+            "cancels": ctl.superseded}
+
+
 SCENARIOS: dict[str, Scenario] = {
     s.name: s for s in (
         Scenario(
@@ -598,6 +678,12 @@ SCENARIOS: dict[str, Scenario] = {
             doc="SLO gauges stay well-formed and agree with the "
                 "achieved map under chaos (seeded chaos walks)",
             factory=_slo_gauges_under_chaos),
+        Scenario(
+            name="supersede_mid_rebalance",
+            doc="a delta mid-rebalance cancels cleanly (no orphan "
+                "tasks) and lands on the sequential run's final map "
+                "(seeded chaos walks)",
+            factory=_supersede_mid_rebalance),
     )
 }
 

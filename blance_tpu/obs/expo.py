@@ -221,6 +221,12 @@ def default_registry() -> MetricsRegistry:
         # -- rebalance -------------------------------------------------------
         Metric("rebalance.recovery_rounds", "counter",
                "failure-aware recovery replan rounds entered"),
+        Metric("rebalance.unconverged", "counter",
+               "rebalances/controller cycles that exhausted their "
+               "recovery budget with failures still outstanding"),
+        Metric("rebalance.degraded", "counter",
+               "recovery replans degraded structurally (e.g. empty "
+               "candidate node set) instead of raising"),
         # -- slo (obs/slo.py; formulas in docs/OBSERVABILITY.md) -------------
         Metric("slo.partition_availability", "gauge",
                "fraction of partitions with at least one serving primary"),
@@ -239,6 +245,29 @@ def default_registry() -> MetricsRegistry:
         Metric("slo.quarantine_exposure_s", "gauge",
                "cumulative seconds each node has spent quarantined "
                "(labeled per node)"),
+        Metric("slo.time_weighted_availability", "gauge",
+               "integral of availability over the run / duration "
+               "(horizon accounting; emitted when timeline tracking "
+               "is on)"),
+        Metric("slo.violation_seconds", "gauge",
+               "cumulative seconds availability sat below the "
+               "configured SLO floor"),
+        # -- sim (rebalance.RebalanceController + testing/simulate.py) -------
+        Metric("sim.events", "counter",
+               "scenario trace events applied by the simulator driver"),
+        Metric("sim.deltas", "counter",
+               "cluster deltas submitted to the rebalance controller"),
+        Metric("sim.rebalances", "counter",
+               "orchestration passes the control loop started"),
+        Metric("sim.superseded", "counter",
+               "in-flight rebalances cancelled because a newer delta "
+               "invalidated them (resumed from the achieved map)"),
+        Metric("sim.degraded_plans", "counter",
+               "planning steps that applied a graceful-degradation "
+               "policy (replica shed / empty candidate set)"),
+        Metric("sim.convergence_lag_s", "histogram",
+               "per-incident seconds from cluster-delta submission to "
+               "the control loop's next quiesce"),
         # -- costmodel (obs/costmodel.py) ------------------------------------
         Metric("costmodel.updates", "counter",
                "EWMA cost-model updates from move-lifecycle spans"),

@@ -22,6 +22,23 @@ whether progress has stalled.  :class:`SloTracker` computes them online:
   spent quarantined/half-open, read from the orchestrator's
   ``HealthTracker``.
 
+With ``track_timeline=True`` the tracker additionally keeps *horizon*
+accounting for the continuous-rebalance control loop (the
+``testing/simulate`` tier, docs/SIMULATOR.md): every availability
+change is appended to a ``(t, availability)`` step timeline, from which
+it derives
+
+- **time-weighted availability** — the integral of the availability
+  step function over the run divided by its duration: the fraction of
+  (partition x seconds) that was actually serving, the honest headline
+  for a run with transient dips;
+- **SLO-violation intervals** — with ``availability_floor`` set, the
+  maximal ``[start, end)`` intervals during which availability sat
+  below the floor, plus their cumulative seconds.
+
+Both are pure functions of the timeline, so under a virtual clock the
+whole horizon account replays bit-identically.
+
 The tracker is an orchestrator *move observer* (``on_batch``): the
 mover calls it after every batch with the outcome.  Updates are plain
 sync methods with no awaits — on the event loop they are atomic, so
@@ -74,6 +91,14 @@ class SloSummary:
     partitions: int
     available_partitions: int
     quarantine_exposure_s: dict[str, float] = field(default_factory=dict)
+    # -- horizon accounting (None/empty unless track_timeline was on) --
+    time_weighted_availability: Optional[float] = None
+    availability_floor: Optional[float] = None
+    violation_s: float = 0.0
+    # Maximal [start, end) intervals with availability < floor, in
+    # tracker-clock seconds.
+    violation_intervals: list[tuple[float, float]] = \
+        field(default_factory=list)
 
 
 class SloTracker:
@@ -88,7 +113,9 @@ class SloTracker:
     def __init__(self, beg_map: Mapping[str, Any],
                  primary_states: Iterable[str] = ("primary",),
                  clock: Optional[Callable[[], float]] = None,
-                 recorder: Optional[Recorder] = None) -> None:
+                 recorder: Optional[Recorder] = None,
+                 track_timeline: bool = False,
+                 availability_floor: Optional[float] = None) -> None:
         self._rec = recorder
         self._clock: Callable[[], float] = (
             clock if clock is not None
@@ -115,6 +142,13 @@ class SloTracker:
         self.moves_failed = 0
         self._t_last_progress = self._clock()
         self._health: Optional[Any] = None
+        # Horizon accounting: a step timeline of (t, availability),
+        # appended only on CHANGE (plus the seed point), so the
+        # integral below is a plain fold over it.
+        self._floor = availability_floor
+        self._t0 = self._t_last_progress
+        self._timeline: Optional[list[tuple[float, float]]] = (
+            [(self._t0, self.availability())] if track_timeline else None)
 
     # -- wiring ---------------------------------------------------------------
 
@@ -145,6 +179,7 @@ class SloTracker:
                 self._apply(mv)
             self.moves_executed += len(moves)
             self._t_last_progress = now
+            self._note_availability(now)
         else:
             self.moves_failed += len(moves)
         self.publish(now)
@@ -169,7 +204,8 @@ class SloTracker:
         if was_available != now_available:
             self._available += 1 if now_available else -1
 
-    def strip_nodes(self, nodes: Iterable[str]) -> None:
+    def strip_nodes(self, nodes: Iterable[str],
+                    now: Optional[float] = None) -> None:
         """Drop every placement on ``nodes`` — the recovery-round
         presumption that a quarantined node's data is lost.  Mirrors
         ``rebalance._strip_nodes`` on the incremental view."""
@@ -185,7 +221,19 @@ class SloTracker:
             now_available = self._primaries[name] > 0
             if was_available != now_available:
                 self._available += 1 if now_available else -1
-        self.publish()
+        self._note_availability(now)
+        self.publish(now)
+
+    def _note_availability(self, now: Optional[float] = None) -> None:
+        """Append to the horizon timeline when availability changed
+        (no-op unless ``track_timeline``).  The timeline is a step
+        function: each entry holds from its ``t`` until the next."""
+        if self._timeline is None:
+            return
+        a = self.availability()
+        if a != self._timeline[-1][1]:
+            t = self._clock() if now is None else now
+            self._timeline.append((t, a))
 
     # -- gauges ---------------------------------------------------------------
 
@@ -203,6 +251,54 @@ class SloTracker:
         """Seconds since the last forward progress (executed move)."""
         t = self._clock() if now is None else now
         return max(t - self._t_last_progress, 0.0)
+
+    def timeline(self) -> list[tuple[float, float]]:
+        """The (t, availability) step timeline (empty unless
+        ``track_timeline``); entry i holds from t_i until t_{i+1}."""
+        return list(self._timeline) if self._timeline is not None else []
+
+    def time_weighted_availability(
+            self, now: Optional[float] = None) -> float:
+        """Integral of the availability step function over [t0, now]
+        divided by the duration — the fraction of partition-seconds
+        that was serving.  Falls back to the instantaneous availability
+        with no timeline or a zero-length horizon."""
+        if self._timeline is None:
+            return self.availability()
+        t = self._clock() if now is None else now
+        if t <= self._t0:
+            return self.availability()
+        total = 0.0
+        for (t_i, a_i), (t_j, _a_j) in zip(self._timeline,
+                                           self._timeline[1:]):
+            total += (t_j - t_i) * a_i
+        t_last, a_last = self._timeline[-1]
+        total += (t - t_last) * a_last
+        return total / (t - self._t0)
+
+    def violation_intervals(
+            self, now: Optional[float] = None) -> list[tuple[float, float]]:
+        """Maximal [start, end) intervals with availability strictly
+        below ``availability_floor`` (empty without a floor or
+        timeline; an interval still open at ``now`` closes at it)."""
+        if self._timeline is None or self._floor is None:
+            return []
+        t = self._clock() if now is None else now
+        out: list[tuple[float, float]] = []
+        open_at: Optional[float] = None
+        for t_i, a_i in self._timeline:
+            if a_i < self._floor and open_at is None:
+                open_at = t_i
+            elif a_i >= self._floor and open_at is not None:
+                out.append((open_at, t_i))
+                open_at = None
+        if open_at is not None:
+            out.append((open_at, max(t, open_at)))
+        return out
+
+    def violation_s(self, now: Optional[float] = None) -> float:
+        """Cumulative seconds spent below the availability floor."""
+        return sum(e - s for s, e in self.violation_intervals(now))
 
     def quarantine_exposure_s(self) -> dict[str, float]:
         """node -> cumulative quarantined seconds, from the attached
@@ -229,6 +325,11 @@ class SloTracker:
         rec.set_gauge("slo.moves_executed", self.moves_executed)
         rec.set_gauge("slo.moves_failed", self.moves_failed)
         rec.set_gauge("slo.min_moves", self._min_moves)
+        if self._timeline is not None:
+            rec.set_gauge("slo.time_weighted_availability",
+                          self.time_weighted_availability(t))
+            if self._floor is not None:
+                rec.set_gauge("slo.violation_seconds", self.violation_s(t))
         exposures = self.quarantine_exposure_s()
         rec.set_gauge("slo.quarantined_nodes", float(len(
             self._health.quarantined_nodes()) if self._health is not None
@@ -250,4 +351,10 @@ class SloTracker:
             partitions=self._total,
             available_partitions=self._available,
             quarantine_exposure_s=self.quarantine_exposure_s(),
+            time_weighted_availability=(
+                self.time_weighted_availability(t)
+                if self._timeline is not None else None),
+            availability_floor=self._floor,
+            violation_s=self.violation_s(t),
+            violation_intervals=self.violation_intervals(t),
         )

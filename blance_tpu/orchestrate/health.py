@@ -137,6 +137,15 @@ class HealthTracker:
             return "probe"
         return "reject"
 
+    def forget(self, node: str) -> None:
+        """Drop ``node``'s breaker state entirely — a node REPLACED by
+        the control plane (e.g. a preempted spot instance or a flapped
+        zone coming back) starts with a clean slate instead of
+        inheriting the dead incarnation's quarantine.  Its accumulated
+        exposure is forgotten with it; read ``exposures()`` before
+        forgetting if the SLO account needs the history."""
+        self._nodes.pop(node, None)
+
     # -- introspection -------------------------------------------------------
 
     def state(self, node: str) -> str:

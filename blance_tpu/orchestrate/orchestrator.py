@@ -214,6 +214,10 @@ class OrchestratorProgress:
     tot_mover_quarantine_reject: int = 0
     tot_quarantine_trips: int = 0
     tot_move_failures: int = 0
+    # Supersede cancellations (Orchestrator.cancel): a newer cluster
+    # delta invalidated this transition mid-flight and the control loop
+    # resumed from achieved_map() instead of letting it finish.
+    tot_cancel: int = 0
 
     def snapshot(self) -> "OrchestratorProgress":
         # One snapshot per progress event: a shallow __dict__ copy is
@@ -366,6 +370,11 @@ class Orchestrator:
             self.health = None
         self._retry_rng = random.Random(options.retry_seed)
         self._missing_mover_warned: set[str] = set()
+        # Set by the supplier AFTER the progress channel closes: the
+        # whole wind-down (movers exited, feeders resolved) is complete.
+        # The supersede path (RebalanceController) awaits it so a
+        # cancelled transition leaves no orphan tasks behind.
+        self._drained = asyncio.Event()
 
     # -- public control surface ---------------------------------------------
 
@@ -382,6 +391,33 @@ class Orchestrator:
             self._bump_sync("tot_stop")
             self._stop_ch.close()
             self._stop_ch = None
+
+    def cancel(self) -> None:
+        """Supersede: stop the transition because a newer cluster delta
+        invalidated its end map.  Semantically a stop() — in-flight
+        callbacks finish or fail like any stop — but counted separately
+        (``tot_cancel``) so dashboards can tell an operator stop from a
+        control-loop supersede.  Resume from ``achieved_map()`` once
+        :meth:`wait_drained` returns.  Idempotent."""
+        if self._stop_ch is not None:
+            self._bump_sync("tot_cancel")
+        self.stop()
+
+    async def wait_drained(self) -> None:
+        """Block until the orchestration has fully wound down — the
+        supplier closed the progress stream after every mover exited.
+        The progress channel must still be drained by its consumer (the
+        documented requirement); this is the rendezvous for a SECOND
+        party (the control loop's supersede path) that needs the
+        wind-down without owning the drain."""
+        await self._drained.wait()
+
+    def pending_tasks(self) -> "list[asyncio.Task[object]]":
+        """Orchestration tasks not yet finished — the no-orphan-tasks
+        probe the supersede explorer scenario asserts empty after a
+        cancel + wait_drained (a just-resolved mover may need one more
+        loop tick to finalize)."""
+        return [t for t in self._tasks if not t.done()]
 
     def pause_new_assignments(self) -> None:
         """Stop starting new assignments; in-flight moves finish.  Idempotent
@@ -897,6 +933,7 @@ class Orchestrator:
         await self._bump("tot_progress_close")
 
         self._progress_ch.close()
+        self._drained.set()
 
     async def _run_supply_move(
         self,

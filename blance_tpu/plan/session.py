@@ -199,6 +199,21 @@ class PlannerSession:
             prob.node_weights[ni] = node_weights.get(n, 1)
         self.invalidate_carry()
 
+    def set_partition_weights(self, weights: dict[str, int]) -> None:
+        """Re-weight partitions in place (hot-tenant drift: the
+        continuous-rebalance controller's weight-delta path).  Missing
+        names fall back to weight 1, mirroring the encoder's default.
+
+        A weight change re-prices every partition's bids — not just the
+        renamed ones — so the warm carry is invalidated and the next
+        replan solves cold and rebuilds it (same contract as
+        ``set_node_weights``)."""
+        self.opts.partition_weights = dict(weights)
+        prob = self._problem
+        for pi, name in enumerate(prob.partitions):
+            prob.partition_weights[pi] = weights.get(name, 1)
+        self.invalidate_carry()
+
     def invalidate_carry(self) -> None:
         """Drop the warm-start state: the next replan() solves cold.
 

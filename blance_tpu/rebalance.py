@@ -30,40 +30,108 @@ import json
 import os
 import tempfile
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Callable, Optional
+from typing import TYPE_CHECKING, Callable, Iterable, Mapping, Optional
 
 from .core.types import (
     Partition,
     PartitionMap,
     PartitionModel,
     PlanOptions,
+    copy_partition_map,
     partition_map_from_json,
     partition_map_to_json,
 )
+from .moves.calc import calc_partition_moves
 from .obs import get_recorder
 from .obs.slo import SloSummary, SloTracker
+from .orchestrate.health import HealthTracker
 from .orchestrate.orchestrator import (
     FindMoveFunc,
     MoveFailure,
+    Orchestrator,
     OrchestratorOptions,
     OrchestratorProgress,
     lowest_weight_partition_move_for_node,
     orchestrate_moves,
 )
 from .plan.api import plan_next_map
+from .plan.greedy import sort_state_names
 from .utils.trace import PhaseTimer
 
 if TYPE_CHECKING:  # annotation-only
     from .plan.session import PlannerSession
 
 __all__ = [
+    "ClusterDelta",
+    "DegradedPlacement",
+    "RebalanceController",
     "RebalanceResult",
     "RecoveryRound",
+    "count_moves",
     "rebalance",
     "rebalance_async",
     "save_partition_map",
     "load_partition_map",
 ]
+
+
+@dataclass(frozen=True)
+class ClusterDelta:
+    """One cluster-membership / workload change fed to the control loop.
+
+    ``add``: nodes joining (or returning — a previously failed node
+    re-added starts with a clean breaker slate).  ``remove``: graceful
+    decommissions — the data is still there, the next plans drain it
+    off.  ``fail``: abrupt losses (spot preemption, zone outage) — the
+    placements are presumed gone NOW, availability drops immediately
+    and the controller re-places from the survivors.  Weight mappings
+    are merged over the controller's running view (hot-tenant drift)."""
+
+    add: tuple[str, ...] = ()
+    remove: tuple[str, ...] = ()
+    fail: tuple[str, ...] = ()
+    partition_weights: Optional[Mapping[str, int]] = None
+    node_weights: Optional[Mapping[str, int]] = None
+
+
+@dataclass
+class DegradedPlacement:
+    """A structured graceful-degradation report — returned as DATA when
+    capacity cannot hold the constraint set, instead of an exception or
+    a silently partial map.
+
+    ``reason`` is ``"no-candidate-nodes"`` (every node removed, failed
+    or quarantined: current placements are kept as-is — or, on a
+    recovery round whose achieved map was already stripped, the empty
+    placement — rather than draining data to nowhere),
+    ``"capacity-shed"`` (fewer candidates than constraint slots per
+    partition: lower-priority replicas were shed first, primaries kept
+    to the last node; ``shed`` maps state -> replicas dropped from its
+    constraint), or ``"no-fixpoint"`` (the planner kept producing moves
+    for the whole pass budget without failures — greedy balance under
+    skewed weights can oscillate — so the cycle was cut off serving but
+    not at the planner's preferred balance)."""
+
+    reason: str
+    nodes_available: int
+    shed: dict[str, int] = field(default_factory=dict)
+    partitions: int = 0
+
+
+def count_moves(model: PartitionModel, beg_map: PartitionMap,
+                end_map: PartitionMap,
+                favor_min_nodes: bool = False) -> int:
+    """Total orchestration moves the beg -> end transition needs (the
+    per-partition move calculus the orchestrator itself runs).  Zero
+    means beg IS end up to move semantics — the control loop's
+    convergence check, and the simulator's offline-optimal churn
+    denominator."""
+    states = sort_state_names(model)
+    return sum(
+        len(calc_partition_moves(
+            states, beg_map[name].nodes_by_state,
+            end_map[name].nodes_by_state, favor_min_nodes))
+        for name in beg_map)
 
 
 @dataclass
@@ -98,6 +166,18 @@ class RebalanceResult:
     # stream on the exposition endpoint during the run; this is the
     # final reading.
     slo: Optional[SloSummary] = None
+    # False when fault-tolerant recovery exhausted max_recovery_rounds
+    # with failures still outstanding (or degraded below) — the
+    # returned map is PARTIAL and must not read as success.
+    # ``residual_failures`` summarizes what is still broken (node ->
+    # outstanding MoveFailure count from the final round).  Legacy mode
+    # has no recovery semantics and always reports True.
+    converged: bool = True
+    residual_failures: dict[str, int] = field(default_factory=dict)
+    # Structured graceful degradation (e.g. a recovery replan with an
+    # EMPTY candidate node set — every node quarantined); None on a
+    # healthy run.
+    degraded: Optional[DegradedPlacement] = None
 
 
 def save_partition_map(pmap: PartitionMap, path: str) -> None:
@@ -275,8 +355,25 @@ async def rebalance_async(
     next_map: PartitionMap = beg
     achieved: Optional[PartitionMap] = None
     quarantined: list[str] = []
+    round_failures: list[MoveFailure] = []
+    degraded: Optional[DegradedPlacement] = None
 
     for round_i in range(1 + max(max_recovery_rounds, 0)):
+        if round_i > 0 and not [n for n in nodes_all if n not in removes]:
+            # Every node is removed/quarantined: a recovery replan has
+            # an EMPTY candidate set.  The achieved map was already
+            # stripped of every dead placement, so the honest target is
+            # the empty placement — surfaced as a structured
+            # degradation, not a planner round that can place nothing
+            # (and not a raise: the simulator's zone-outage scenarios
+            # hit this in normal operation).
+            degraded = DegradedPlacement(
+                reason="no-candidate-nodes", nodes_available=0,
+                partitions=len(beg))
+            rec.count("rebalance.degraded")
+            next_map = {name: Partition(name, {s: [] for s in model})
+                        for name in beg}
+            break
         phase = "plan" if round_i == 0 else f"recovery_plan_{round_i}"
         with timer.phase(phase):
             next_map = plan(beg, removes, adds, warm_ok,
@@ -372,6 +469,19 @@ async def rebalance_async(
         removes = sorted(set(removes) | set(quarantined))
         adds = []
 
+    # Recovery exhaustion is DATA, not silence: a run that still has
+    # failures outstanding after its last round (or that degraded to an
+    # empty placement) is not converged, and the residual summary says
+    # what is still broken — a partial map must never be
+    # indistinguishable from success.
+    residual: dict[str, int] = {}
+    converged = True
+    if ft and (round_failures or degraded is not None):
+        converged = False
+        for f in round_failures:
+            residual[f.node] = residual.get(f.node, 0) + 1
+        rec.count("rebalance.unconverged")
+
     slo.publish()
     return RebalanceResult(
         next_map=next_map,
@@ -384,9 +494,517 @@ async def rebalance_async(
         achieved_map=achieved,
         quarantined_nodes=list(quarantined),
         slo=slo.summary(),
+        converged=converged,
+        residual_failures=residual,
+        degraded=degraded,
     )
 
 
 def rebalance(*args, **kwargs) -> RebalanceResult:
     """Synchronous wrapper around rebalance_async (runs its own loop)."""
     return asyncio.run(rebalance_async(*args, **kwargs))
+
+
+def _maps_equal(a: PartitionMap, b: PartitionMap) -> bool:
+    """Placement equality up to empty state lists (an emptied state vs
+    a never-present one).  In-list ORDER is kept — index 0 is "the
+    primary" by contract."""
+    def norm(m: PartitionMap) -> dict:
+        return {name: {s: list(ns) for s, ns in p.nodes_by_state.items()
+                       if ns}
+                for name, p in m.items()}
+    return norm(a) == norm(b)
+
+
+class RebalanceController:
+    """The continuous-rebalance control loop (ROADMAP item 4).
+
+    ``rebalance_async`` is one bounded episode; production is a loop:
+    cluster deltas (:class:`ClusterDelta`) arrive at any time, and the
+    controller keeps the cluster converging while it serves —
+
+    - **debounce**: deltas arriving within ``debounce_s`` of each other
+      coalesce into one planning cycle (a zone outage is dozens of node
+      events, not dozens of rebalances);
+    - **supersede**: a delta landing mid-rebalance CANCELS the in-flight
+      transition (``Orchestrator.cancel``), waits for the wind-down, and
+      resumes from ``achieved_map()`` — never from a stale plan;
+    - **warm carry**: with a :class:`~blance_tpu.plan.session.
+      PlannerSession`, clean cycles ride the solver carry across plans
+      (load/adopt gated exactly like ``rebalance_async``);
+    - **graceful degradation**: when the candidate set cannot hold the
+      constraint set, lower-priority replicas are shed before primaries
+      and a structured :class:`DegradedPlacement` lands in
+      ``degraded_reports`` instead of an exception; an EMPTY candidate
+      set keeps the current placements (never drains data to nowhere);
+    - **convergence accounting**: each cycle replans until the move
+      calculus reports zero moves; a cycle that exhausts
+      ``max_passes_per_cycle`` with failures outstanding counts
+      ``rebalance.unconverged`` and leaves the residue for the next
+      delta.
+
+    Single-task discipline (analysis/race_lint.py ``SHARED_STATE``):
+    every mutation of the shared control state happens in a sync
+    window, either on the app-facing surface (``submit``/``stop_soon``)
+    or inside the controller task — the bounded rendezvous between them
+    is the wake event plus the pending-delta list, taken atomically.
+
+    Time comes exclusively from the recorder's clock, so the whole loop
+    — debounce windows included — runs deterministically under
+    ``testing.sched.DeterministicLoop`` (the ``testing/simulate`` tier
+    replays a week of cluster life in seconds, bit-identically).
+    """
+
+    def __init__(
+        self,
+        model: PartitionModel,
+        nodes_all: list[str],
+        current_map: PartitionMap,
+        assign_partitions: Callable[..., object],
+        *,
+        plan_options: Optional[PlanOptions] = None,
+        orchestrator_options: Optional[OrchestratorOptions] = None,
+        backend: str = "greedy",
+        session: "Optional[PlannerSession]" = None,
+        find_move: Optional[FindMoveFunc] = None,
+        debounce_s: float = 0.05,
+        max_passes_per_cycle: int = 8,
+        slo: Optional[SloTracker] = None,
+        move_observers: tuple = (),
+    ) -> None:
+        self.model = model
+        self._assign = assign_partitions
+        self._find_move = find_move
+        # Private copy: the controller folds weight deltas into its
+        # options view, and mutating a caller-shared PlanOptions would
+        # leak this loop's weights into unrelated plans.
+        self.opts = dataclasses.replace(plan_options) \
+            if plan_options is not None else PlanOptions()
+        self.orch_opts = orchestrator_options or OrchestratorOptions()
+        self.backend = backend
+        self.session = session
+        self.debounce_s = debounce_s
+        self.max_passes_per_cycle = max(int(max_passes_per_cycle), 1)
+        self._rec = get_recorder()
+        self.current: PartitionMap = copy_partition_map(current_map)
+        self._nodes: list[str] = list(nodes_all)
+        self._removing: set[str] = set()  # graceful decommissions
+        self._failed: set[str] = set()  # abrupt losses (stripped)
+        self._pweights: dict[str, int] = dict(
+            self.opts.partition_weights or {})
+        self._nweights: dict[str, int] = dict(self.opts.node_weights or {})
+        self._slo = slo
+        self._observers = ((slo,) if slo is not None else ()) + \
+            tuple(move_observers)
+        # One breaker for the WHOLE loop: quarantine survives cycles
+        # (a dead node stays dark) until an explicit re-add forgets it.
+        if self.orch_opts.health is not None:
+            self.health: Optional[HealthTracker] = self.orch_opts.health
+        elif self.orch_opts.quarantine_after > 0:
+            self.health = HealthTracker(
+                threshold=self.orch_opts.quarantine_after,
+                probe_after_s=self.orch_opts.probe_after_s,
+                clock=self._rec.now)
+        else:
+            self.health = None
+        if self._slo is not None and self.health is not None:
+            self._slo.attach_health(self.health)
+
+        self._pending: list[ClusterDelta] = []
+        self._wake = asyncio.Event()
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._inflight: Optional[Orchestrator] = None
+        self._stopping = False
+        self._task: "Optional[asyncio.Task[object]]" = None
+        # Introspection / scoring surface:
+        self.warnings: dict[str, list[str]] = {}
+        self.failures: list[MoveFailure] = []
+        self.degraded_reports: list[DegradedPlacement] = []
+        self.cycles = 0
+        self.passes = 0
+        self.superseded = 0
+        self.unconverged_cycles = 0
+        # Called with the recorder-clock time whenever the controller
+        # returns to idle (no pending deltas, nothing in flight) — the
+        # simulator's per-incident convergence-lag hook.
+        self.on_quiesce: list[Callable[[float], None]] = []
+        # Called with (nodes, t) whenever placements are stripped (an
+        # abrupt fail delta, or quarantined placements presumed lost) —
+        # the simulator's event log needs every strip to make the SLO
+        # account recomputable from the log alone.
+        self.on_strip: list[Callable[[set[str], float], None]] = []
+
+    # -- app-facing control surface (sync: single atomic windows) ---------
+
+    def submit(self, delta: ClusterDelta) -> None:
+        """Enqueue a cluster delta; coalesces with everything else that
+        arrives within the debounce window.  Sync and re-entrant from
+        progress callbacks."""
+        self._pending.append(delta)
+        self._rec.count("sim.deltas")
+        self._idle.clear()
+        self._wake.set()
+
+    def stop_soon(self) -> None:
+        """Request wind-down: cancels any in-flight transition and lets
+        the controller task exit.  Sync; pair with ``await stop()`` (or
+        await the start() task) for the rendezvous."""
+        self._stopping = True
+        self._wake.set()
+        o = self._inflight
+        if o is not None:
+            o.cancel()
+
+    def start(self) -> "asyncio.Task[object]":
+        """Spawn the controller task (requires a running loop)."""
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+            self._task.set_name("rebalance-controller")
+        return self._task
+
+    async def stop(self) -> None:
+        """stop_soon + await the controller task's exit."""
+        self.stop_soon()
+        if self._task is not None:
+            await self._task
+
+    async def quiesce(self) -> PartitionMap:
+        """Wait until the controller is idle (every submitted delta
+        planned, orchestrated and converged — or structurally degraded)
+        and return the current map."""
+        await self._idle.wait()
+        return self.current
+
+    def quarantined_nodes(self) -> list[str]:
+        return self.health.quarantined_nodes() \
+            if self.health is not None else []
+
+    def live_nodes(self) -> list[str]:
+        """Nodes currently eligible as placement candidates (known,
+        not decommissioning, not failed, not quarantined), in tie-break
+        order — the simulator's offline-optimal baseline node set."""
+        return self._candidates()
+
+    def pending_tasks(self) -> "list[asyncio.Task[object]]":
+        """Unfinished orchestration/controller tasks — the no-orphan
+        probe for the supersede explorer scenario."""
+        out: "list[asyncio.Task[object]]" = []
+        if self._task is not None and not self._task.done():
+            out.append(self._task)
+        o = self._inflight
+        if o is not None:
+            out.extend(o.pending_tasks())
+        return out
+
+    # -- the loop ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        try:
+            while not self._stopping:
+                if not self._pending:
+                    self._set_idle()
+                    await self._wake.wait()
+                    continue
+                if self.debounce_s > 0:
+                    # Coalesce the burst: everything that lands during
+                    # this (virtual-time) window joins the cycle.
+                    await asyncio.sleep(self.debounce_s)
+                deltas = self._take_pending()
+                if deltas:
+                    self._apply_deltas(deltas)
+                    self.cycles += 1
+                    await self._converge()
+        finally:
+            self._set_idle()
+
+    def _take_pending(self) -> list[ClusterDelta]:
+        taken, self._pending = self._pending, []
+        self._wake.clear()
+        return taken
+
+    def _set_idle(self) -> None:
+        if not self._idle.is_set():
+            self._idle.set()
+            t = self._rec.now()
+            for hook in self.on_quiesce:
+                hook(t)
+
+    def _apply_deltas(self, deltas: Iterable[ClusterDelta]) -> None:
+        """Fold deltas into the membership/weight view, IN ORDER (a
+        fail followed by a re-add in one burst comes back clean).  One
+        sync window: placements strip atomically with the view."""
+        weights_changed = False
+        for delta in deltas:
+            for n in delta.add:
+                if n not in self._nodes:
+                    self._nodes.append(n)
+                self._removing.discard(n)
+                if n in self._failed:
+                    self._failed.discard(n)
+                if self.health is not None:
+                    self.health.forget(n)
+            self._removing.update(
+                n for n in delta.remove if n in self._nodes)
+            fresh = [n for n in delta.fail
+                     if n in self._nodes and n not in self._failed]
+            if fresh:
+                self._failed.update(fresh)
+                self.current = _strip_nodes(self.current, set(fresh))
+                t = self._rec.now()
+                if self._slo is not None:
+                    self._slo.strip_nodes(set(fresh), t)
+                for hook in self.on_strip:
+                    hook(set(fresh), t)
+            if delta.partition_weights:
+                self._pweights.update(delta.partition_weights)
+                weights_changed = True
+            if delta.node_weights:
+                self._nweights.update(delta.node_weights)
+                weights_changed = True
+        self.opts.partition_weights = dict(self._pweights) or None
+        self.opts.node_weights = dict(self._nweights) or None
+        if self.session is not None:
+            self._mirror_session(weights_changed)
+
+    def _mirror_session(self, weights_changed: bool) -> None:
+        """Push the folded membership/weight view into the session.
+        Weight updates invalidate the carry (they re-price everything)
+        so they are mirrored only when this burst actually changed
+        them; membership changes keep the carry warm via the session's
+        own dirty masks.
+
+        The dark set mirrored as removed includes QUARANTINED nodes —
+        the session must never plan onto a node whose mover is
+        excluded, or the pass wedges on a moverless target — and a
+        node the session still counts removed but the controller
+        considers eligible again (a failed node re-added, a healed
+        breaker) is re-added, clearing the session's removal flag:
+        returned capacity must not stay dark."""
+        session = self.session
+        assert session is not None
+        dark = self._removing | self._failed | set(self.quarantined_nodes())
+        known = set(session.nodes)
+        back = [n for n in self._nodes
+                if n not in known
+                or (n in set(session.removed_nodes) and n not in dark)]
+        if back:
+            session.add_nodes(back)
+        gone = sorted(dark - set(session.removed_nodes))
+        if gone:
+            session.remove_nodes(gone)
+        if weights_changed:
+            if self._pweights:
+                session.set_partition_weights(dict(self._pweights))
+            if self._nweights:
+                session.set_node_weights(dict(self._nweights))
+
+    def _candidates(self) -> list[str]:
+        dark = self._removing | self._failed | set(self.quarantined_nodes())
+        return [n for n in self._nodes if n not in dark]
+
+    def _mover_nodes(self) -> list[str]:
+        """Nodes that get movers this pass: failed and quarantined
+        nodes are gone (their queued work must drain as failures, and
+        feeding them would burn the retry budget); GRACEFUL removals
+        keep movers — their 'del' moves are real work."""
+        dark = self._failed | set(self.quarantined_nodes())
+        return [n for n in self._nodes if n not in dark]
+
+    # -- planning with graceful degradation --------------------------------
+
+    def _effective_constraints(self) -> dict[str, int]:
+        out = {s: st.constraints for s, st in self.model.items()}
+        for s, c in (self.opts.model_state_constraints or {}).items():
+            if s in out:
+                out[s] = c
+        return out
+
+    def _shed_plan(self, n_candidates: int) \
+            -> tuple[Optional[dict[str, int]], dict[str, int]]:
+        """(degraded constraints, shed per state) when the candidate
+        set cannot hold the full constraint set; (None, {}) when no
+        shedding is needed.  Lowest-priority states shed first; the
+        top-priority state keeps at least one copy."""
+        eff = self._effective_constraints()
+        total = sum(eff.values())
+        if total <= n_candidates:
+            return None, {}
+        top = min((st.priority for st in self.model.values()), default=0)
+        shed: dict[str, int] = {}
+        # Highest priority VALUE (least important) first; name-sorted
+        # within a tier for determinism.
+        for s in sorted(eff, key=lambda s: (-self.model[s].priority, s)):
+            floor = 1 if self.model[s].priority == top else 0
+            while total > n_candidates and eff[s] > floor:
+                eff[s] -= 1
+                shed[s] = shed.get(s, 0) + 1
+                total -= 1
+        return eff, shed
+
+    def _plan(self, candidates: list[str]) \
+            -> tuple[Optional[PartitionMap], Optional[DegradedPlacement]]:
+        """One planning step.  (None, report) when there is nothing a
+        plan could place (empty candidate set: keep current placements
+        rather than draining data to nowhere)."""
+        if not candidates:
+            return None, DegradedPlacement(
+                reason="no-candidate-nodes", nodes_available=0,
+                partitions=len(self.current))
+        removes = sorted(self._removing | self._failed |
+                         set(self.quarantined_nodes()))
+        degraded_constraints, shed = self._shed_plan(len(candidates))
+        report = None
+        if degraded_constraints is not None:
+            report = DegradedPlacement(
+                reason="capacity-shed", nodes_available=len(candidates),
+                shed=shed, partitions=len(self.current))
+        if self.session is not None and report is None:
+            next_map, warns = self._plan_session()
+        else:
+            opts = self.opts
+            if degraded_constraints is not None:
+                # Shedding bypasses the session: the session's encoded
+                # statics pin the full constraint set.
+                opts = dataclasses.replace(
+                    self.opts,
+                    model_state_constraints=degraded_constraints)
+            next_map, warns = plan_next_map(
+                self.current, self.current, list(self._nodes), removes,
+                [], self.model, opts, backend=self.backend)
+        for k, v in warns.items():
+            self.warnings.setdefault(k, []).extend(v)
+        return next_map, report
+
+    def _plan_session(self) -> tuple[PartitionMap, dict[str, list[str]]]:
+        session = self.session
+        assert session is not None
+        if not _session_matches(session, self.current):
+            session.load_map(self.current)  # cold: invalidates the carry
+        # Re-push membership before EVERY session plan (weights stay:
+        # the session's own opts already carry them, and re-encodes
+        # read them back in): the breaker can quarantine a node
+        # between passes, and a plan that still targets it would wedge
+        # on a moverless mover.
+        self._mirror_session(weights_changed=False)
+        session.replan()
+        return session.to_map("proposed")
+
+    # -- one converge cycle -------------------------------------------------
+
+    async def _converge(self) -> None:
+        """Plan/orchestrate until the move calculus reports zero moves,
+        a new delta supersedes the cycle, or the pass budget runs out."""
+        passes = 0
+        while not self._stopping:
+            next_map, report = self._plan(self._candidates())
+            if report is not None:
+                self.degraded_reports.append(report)
+                self._rec.count("sim.degraded_plans")
+            if next_map is None:
+                break
+            if count_moves(self.model, self.current, next_map,
+                           self.orch_opts.favor_min_nodes) == 0:
+                if self.session is not None and \
+                        _maps_equal(self.current, next_map):
+                    # Fixpoint reached with the proposal == current:
+                    # adopt it so the NEXT cycle warm-starts.
+                    self.session.apply()
+                break
+            passes += 1
+            self.passes += 1
+            self._rec.count("sim.rebalances")
+            superseded, failures = await self._one_pass(next_map)
+            if superseded:
+                return
+            if passes >= self.max_passes_per_cycle:
+                # The pass budget is a HARD bound, failures or not: a
+                # planner that keeps reshuffling (greedy balance under
+                # skewed weights has states with no fixpoint — plans
+                # oscillate) must not spin the control loop forever.
+                # The cycle ends unconverged, structurally: the map is
+                # serving (every executed pass was complete
+                # make-before-break work), the residue waits for the
+                # next delta.
+                self.unconverged_cycles += 1
+                self._rec.count("rebalance.unconverged")
+                if not failures:
+                    self.degraded_reports.append(DegradedPlacement(
+                        reason="no-fixpoint",
+                        nodes_available=len(self._candidates()),
+                        partitions=len(self.current)))
+                    self._rec.count("sim.degraded_plans")
+                break
+
+    async def _one_pass(self, next_map: PartitionMap) \
+            -> tuple[bool, list[MoveFailure]]:
+        """One orchestration pass toward ``next_map``; True when a new
+        delta superseded it mid-flight (resume happens in the outer
+        loop, from the achieved map adopted here either way)."""
+        opts = self.orch_opts
+        if self.health is not None:
+            opts = dataclasses.replace(opts, health=self.health)
+        o = orchestrate_moves(
+            self.model, opts, self._mover_nodes(), self.current, next_map,
+            self._assign, self._find_move, move_observers=self._observers)
+        self._inflight = o
+        drain = asyncio.ensure_future(self._drain_progress(o))
+        superseded = False
+        while not drain.done():
+            waiter = asyncio.ensure_future(self._wake_wait())
+            await asyncio.wait({drain, waiter},
+                               return_when=asyncio.FIRST_COMPLETED)
+            if not waiter.done():
+                waiter.cancel()
+                try:
+                    await waiter
+                except asyncio.CancelledError:
+                    pass
+            if drain.done():
+                break
+            if self._pending and not self._stopping:
+                # Supersede: the plan in flight no longer matches the
+                # cluster.  Cancel, wait the full wind-down (no orphan
+                # tasks), resume from the achieved map.
+                superseded = True
+                self.superseded += 1
+                self._rec.count("sim.superseded")
+            o.cancel()
+            await o.wait_drained()
+            break
+        await drain
+        self._adopt(o)
+        return superseded, o.move_failures()
+
+    async def _wake_wait(self) -> None:
+        await self._wake.wait()
+
+    async def _drain_progress(self, o: Orchestrator) -> None:
+        async for _progress in o.progress_ch():
+            pass
+        o.stop()
+
+    def _adopt(self, o: Orchestrator) -> None:
+        """Fold one finished pass into the controller view (sync: one
+        atomic window).  Quarantined placements are presumed lost, like
+        rebalance_async's recovery presumption."""
+        quarantined = set(o.health.quarantined_nodes()) \
+            if o.health is not None else set()
+        achieved = o.achieved_map()
+        if quarantined:
+            achieved = _strip_nodes(achieved, quarantined)
+            t = self._rec.now()
+            if self._slo is not None:
+                self._slo.strip_nodes(quarantined, t)
+            for hook in self.on_strip:
+                hook(set(quarantined), t)
+        failures = o.move_failures()
+        self.failures.extend(failures)
+        self.current = achieved
+        self._inflight = None
+        if self.session is not None and not failures and \
+                not quarantined and \
+                _maps_equal(self.current, o.end_map):
+            # Clean pass: the proposal landed verbatim — adopt it so
+            # the next plan rides the warm carry.
+            self.session.apply()

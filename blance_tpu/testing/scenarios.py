@@ -1,0 +1,307 @@
+"""Scenario DSL for the continuous-rebalance simulator.
+
+A :class:`SimScenario` is a fully DECLARATIVE description of one run of
+cluster life: the initial topology (nodes across zones, partitions,
+replica count), a seeded trace of timed :class:`SimEvent`s (each one a
+:class:`~blance_tpu.rebalance.ClusterDelta` — joins, graceful
+decommissions, abrupt spot preemptions, zone outages, hot-tenant weight
+drift), the mover fault profiles (``orchestrate.faults.NodeFaults``,
+SHA-seeded so flakes replay bit-identically), and the SLO floor the run
+is scored against.  ``testing/simulate.py`` executes it under the
+``DeterministicLoop`` virtual clock.
+
+Determinism contract: builders derive every stochastic choice from
+``random.Random(seed)`` at BUILD time — the scenario object is the
+complete script, and running it twice (or on another machine) replays
+the same cluster life bit-for-bit (docs/SIMULATOR.md).
+
+The registry at the bottom maps scenario-family names to builders
+taking a seed — the CI ``sim-smoke`` matrix is 3 fixed seeds x three
+families, plus the ``slow``-marked 7-virtual-day ``mixed_week``.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Mapping
+
+from ..core.types import Partition, PartitionMap, PartitionModel, model
+from ..orchestrate.faults import NodeFaults
+from ..rebalance import ClusterDelta
+
+__all__ = [
+    "SimEvent",
+    "SimScenario",
+    "initial_map",
+    "scenario_model",
+    "spot_preemption",
+    "zone_flap",
+    "weight_drift",
+    "mixed_week",
+    "SCENARIOS",
+]
+
+
+@dataclass(frozen=True)
+class SimEvent:
+    """One timed cluster delta in a scenario trace.
+
+    ``outage=True`` marks a SCRIPTED loss window: availability is
+    allowed to drop from this event until the control loop's next
+    quiesce.  Any availability drop OUTSIDE such a window is a
+    simulator invariant violation (lost primaries nobody scripted)."""
+
+    t: float
+    delta: ClusterDelta
+    label: str = ""
+    outage: bool = False
+
+
+@dataclass(frozen=True)
+class SimScenario:
+    """A complete, self-describing simulator run (see module doc)."""
+
+    name: str
+    seed: int
+    horizon_s: float
+    nodes: tuple[str, ...]
+    partitions: int
+    replicas: int = 1
+    events: tuple[SimEvent, ...] = ()
+    availability_floor: float = 0.85
+    # Mover fault profiles (orchestrate/faults.py), keyed by node; the
+    # FaultPlan seed is the scenario seed.
+    fault_nodes: Mapping[str, NodeFaults] = field(default_factory=dict)
+    # Virtual per-batch data-plane latency: base for every node, plus
+    # per-node overrides (slow movers).
+    base_latency_s: float = 2.0
+    node_latency_s: Mapping[str, float] = field(default_factory=dict)
+    # Control-loop knobs.
+    debounce_s: float = 1.0
+    move_timeout_s: float = 120.0
+    max_retries: int = 3
+    backoff_base_s: float = 1.0
+    quarantine_after: int = 5
+    probe_after_s: float = 86_400.0  # quarantine is terminal unless re-added
+    max_passes_per_cycle: int = 8
+    use_session: bool = False
+    backend: str = "greedy"
+    max_steps: int = 4_000_000
+
+
+def scenario_model(scn: SimScenario) -> PartitionModel:
+    """primary(+replicas) model for a scenario."""
+    if scn.replicas > 0:
+        return model(primary=(0, 1), replica=(1, scn.replicas))
+    return model(primary=(0, 1))
+
+
+def initial_map(scn: SimScenario) -> PartitionMap:
+    """Deterministic round-robin seed placement: partition i's primary
+    on node i mod N, replicas on the next distinct nodes — balanced,
+    zone-striped (node order interleaves zones), no RNG involved."""
+    nodes = list(scn.nodes)
+    n = len(nodes)
+    out: PartitionMap = {}
+    for i in range(scn.partitions):
+        name = f"p{i:04d}"
+        nbs: dict[str, list[str]] = {"primary": [nodes[i % n]]}
+        if scn.replicas > 0:
+            nbs["replica"] = [nodes[(i + 1 + r) % n]
+                              for r in range(scn.replicas)]
+        out[name] = Partition(name, nbs)
+    return out
+
+
+def _zone_nodes(zones: int, per_zone: int) -> tuple[str, ...]:
+    """z0n0, z1n0, z2n0, z0n1, ... — zone-striped so round-robin seed
+    placement spreads replicas across zones."""
+    return tuple(f"z{z}n{i}" for i in range(per_zone)
+                 for z in range(zones))
+
+
+def _jitter(rng: random.Random, t: float, spread: float) -> float:
+    """Deterministic +-spread jitter, quantized to ms so event-log
+    timestamps stay platform-stable text."""
+    return round(t + rng.uniform(-spread, spread), 3)
+
+
+# -- scenario families --------------------------------------------------------
+
+
+def spot_preemption(seed: int = 11) -> SimScenario:
+    """Bulk simultaneous spot kills: ~a third of the fleet vanishes in
+    ONE delta, replacements join later, then a graceful decommission —
+    the cloud-capacity churn staple."""
+    rng = random.Random(f"spot:{seed}")
+    nodes = _zone_nodes(3, 4)  # 12 nodes
+    victims = tuple(sorted(rng.sample(nodes, 4)))
+    replacements = tuple(f"r{i}" for i in range(4))
+    retire = rng.choice([n for n in nodes if n not in victims])
+    events = (
+        SimEvent(t=_jitter(rng, 300, 30),
+                 delta=ClusterDelta(fail=victims),
+                 label="spot-preemption", outage=True),
+        SimEvent(t=_jitter(rng, 1200, 60),
+                 delta=ClusterDelta(add=replacements),
+                 label="replacements-join"),
+        SimEvent(t=_jitter(rng, 2400, 60),
+                 delta=ClusterDelta(remove=(retire,)),
+                 label="graceful-retire"),
+    )
+    return SimScenario(
+        name="spot_preemption", seed=seed, horizon_s=3600.0,
+        nodes=nodes, partitions=48, replicas=1, events=events,
+        availability_floor=0.6)
+
+
+def zone_flap(seed: int = 23) -> SimScenario:
+    """Rolling zone outages: each zone goes dark and comes back in
+    turn, with overlap (the next zone fails before the previous
+    recovery fully drains) and a flaky mover in the surviving set."""
+    rng = random.Random(f"flap:{seed}")
+    zones, per_zone = 3, 4
+    nodes = _zone_nodes(zones, per_zone)
+    by_zone = {z: tuple(n for n in nodes if n.startswith(f"z{z}"))
+               for z in range(zones)}
+    flaky = by_zone[2][-1]
+    events: list[SimEvent] = []
+    t = 600.0
+    for z in range(zones):
+        down = _jitter(rng, t, 30)
+        events.append(SimEvent(
+            t=down, delta=ClusterDelta(fail=by_zone[z]),
+            label=f"zone-z{z}-outage", outage=True))
+        # The zone returns while the NEXT zone's outage may already be
+        # in flight — overlapping deltas are the point.
+        events.append(SimEvent(
+            t=_jitter(rng, down + 900, 30),
+            delta=ClusterDelta(add=by_zone[z]),
+            label=f"zone-z{z}-returns"))
+        t += 1100.0
+    return SimScenario(
+        name="zone_flap", seed=seed, horizon_s=5400.0,
+        nodes=nodes, partitions=48, replicas=1,
+        events=tuple(events), availability_floor=0.5,
+        fault_nodes={flaky: NodeFaults(fail_rate=0.2)},
+        quarantine_after=8)
+
+
+def weight_drift(seed: int = 37) -> SimScenario:
+    """Hot-tenant weight drift, no faults: waves of partitions heat up
+    (weight 1 -> 8) and cool back down, each wave a replan the loop
+    must absorb without ever dropping availability."""
+    rng = random.Random(f"drift:{seed}")
+    nodes = _zone_nodes(2, 4)  # 8 nodes
+    partitions = 32
+    events: list[SimEvent] = []
+    hot: list[str] = []
+    t = 300.0
+    for _wave in range(4):
+        cooled = {p: 1 for p in hot}
+        hot = sorted(rng.sample([f"p{i:04d}" for i in range(partitions)],
+                                partitions // 8))
+        heated = {p: 8 for p in hot}
+        events.append(SimEvent(
+            t=_jitter(rng, t, 20),
+            delta=ClusterDelta(partition_weights={**cooled, **heated}),
+            label="hot-tenant-wave"))
+        t += 700.0
+    return SimScenario(
+        name="weight_drift", seed=seed, horizon_s=3600.0,
+        nodes=nodes, partitions=partitions, replicas=1,
+        events=tuple(events), availability_floor=0.999)
+
+
+def mixed_week(seed: int = 7, days: float = 7.0) -> SimScenario:
+    """The long-horizon soak: ``days`` of virtual cluster life mixing
+    every fault family — daily join/decommission churn, two spot
+    preemption bursts, a zone flap, hot-tenant waves, plus
+    deliberately OVERLAPPING deltas (a second event a few virtual
+    seconds after the first, landing mid-rebalance to exercise the
+    supersede path).  >= 20 deltas at the default horizon."""
+    rng = random.Random(f"week:{seed}")
+    nodes = _zone_nodes(3, 4)
+    partitions = 48
+    horizon = days * 86_400.0
+    day = 86_400.0
+    events: list[SimEvent] = []
+    spare = [f"s{i}" for i in range(16)]  # standby capacity to rotate in
+    in_cluster = list(nodes)
+
+    def take_spare() -> str:
+        return spare.pop(0)
+
+    # Daily churn: one join + one graceful decommission per day, a few
+    # virtual minutes apart.
+    for d in range(int(days)):
+        base = d * day
+        join = take_spare()
+        t_join = _jitter(rng, base + 0.25 * day, 1800)
+        events.append(SimEvent(
+            t=t_join, delta=ClusterDelta(add=(join,)),
+            label=f"day{d}-join"))
+        in_cluster.append(join)
+        retire = rng.choice(sorted(in_cluster))
+        in_cluster.remove(retire)
+        # Overlap: the decommission lands seconds after the join's
+        # rebalance began — a supersede, not a fresh cycle.
+        events.append(SimEvent(
+            t=round(t_join + rng.uniform(5.0, 30.0), 3),
+            delta=ClusterDelta(remove=(retire,)),
+            label=f"day{d}-retire-overlapping"))
+    # Two spot bursts.
+    for burst, when in enumerate((1.4 * day, 4.6 * day)):
+        victims = tuple(sorted(rng.sample(sorted(in_cluster), 3)))
+        for v in victims:
+            in_cluster.remove(v)
+        t_kill = _jitter(rng, when, 3600)
+        events.append(SimEvent(
+            t=t_kill, delta=ClusterDelta(fail=victims),
+            label=f"spot-burst-{burst}", outage=True))
+        repl = tuple(take_spare() for _ in range(3))
+        in_cluster.extend(repl)
+        events.append(SimEvent(
+            t=_jitter(rng, t_kill + 0.1 * day, 600),
+            delta=ClusterDelta(add=repl),
+            label=f"spot-burst-{burst}-replacements"))
+    # One zone flap mid-week (whichever z1 originals are still in).
+    z1 = tuple(n for n in sorted(in_cluster) if n.startswith("z1"))
+    if z1:
+        t_down = _jitter(rng, 3.2 * day, 3600)
+        events.append(SimEvent(
+            t=t_down, delta=ClusterDelta(fail=z1),
+            label="zone-z1-outage", outage=True))
+        events.append(SimEvent(
+            t=_jitter(rng, t_down + 0.05 * day, 600),
+            delta=ClusterDelta(add=z1), label="zone-z1-returns"))
+    # Hot-tenant waves every other day.
+    hot: list[str] = []
+    for w in range(3):
+        cooled = {p: 1 for p in hot}
+        hot = sorted(rng.sample([f"p{i:04d}" for i in range(partitions)],
+                                6))
+        events.append(SimEvent(
+            t=_jitter(rng, (2 * w + 0.8) * day, 3600),
+            delta=ClusterDelta(
+                partition_weights={**cooled, **{p: 8 for p in hot}}),
+            label=f"hot-wave-{w}"))
+    events.sort(key=lambda e: (e.t, e.label))
+    return SimScenario(
+        name="mixed_week", seed=seed, horizon_s=horizon,
+        nodes=nodes, partitions=partitions, replicas=1,
+        events=tuple(events), availability_floor=0.6,
+        fault_nodes={"z0n3": NodeFaults(fail_rate=0.1)},
+        quarantine_after=8, max_steps=8_000_000)
+
+
+# Scenario-family registry: name -> builder(seed).  The CI sim-smoke
+# matrix crosses the first three with its fixed seeds; mixed_week is
+# the slow-marked long-horizon soak.
+SCENARIOS: dict[str, Callable[[int], SimScenario]] = {
+    "spot_preemption": spot_preemption,
+    "zone_flap": zone_flap,
+    "weight_drift": weight_drift,
+    "mixed_week": mixed_week,
+}

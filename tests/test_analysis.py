@@ -590,9 +590,15 @@ def test_race_lint_real_package_model_matches_reality():
     import blance_tpu.orchestrate.csp as csp
     import blance_tpu.orchestrate.health as health
     import blance_tpu.orchestrate.orchestrator as orch
+    import importlib
+
     import blance_tpu.plan.carry as plancarry
     import blance_tpu.plan.service as planservice
     from blance_tpu.analysis.race_lint import SHARED_STATE
+
+    # `import blance_tpu.rebalance as ...` would resolve to the
+    # same-named FUNCTION the package re-exports, not the module.
+    rebalance = importlib.import_module("blance_tpu.rebalance")
 
     import inspect
 
@@ -608,6 +614,8 @@ def test_race_lint_real_package_model_matches_reality():
         "CostModel": inspect.getsource(costmodel.CostModel),
         "PlanService": inspect.getsource(planservice.PlanService),
         "CarryCache": inspect.getsource(plancarry.CarryCache),
+        "RebalanceController": inspect.getsource(
+            rebalance.RebalanceController),
     }
     for cls, attrs in SHARED_STATE.items():
         src = sources[cls]

@@ -179,6 +179,7 @@ def test_two_movers_three_partitions_bounded_exhaustive():
     "stop_during_quarantine_probe",
     "movers_race_breaker_trip",
     "slo_gauges_under_chaos",
+    "supersede_mid_rebalance",
 ])
 def test_chaos_scenarios_pinned_seed_walks(name):
     for seed, out in run_scenario_walks(SCENARIOS[name], CI_WALK_SEEDS):
