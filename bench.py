@@ -973,6 +973,148 @@ def _bench_delta_replan_body(P, N, m, nodes, parts, opts, rec, sweeps,
     return out
 
 
+def bench_sparse(P, N, k=None, identity_shape=(512, 64)):
+    """Sparse shortlist solve vs the dense engines (ISSUE 11).
+
+    Three parts, all reported in one block:
+
+    - **saturating-K bit-identity** at a small dense-feasible shape:
+      solve_sparse with K = N must equal the dense converged solve
+      bit-for-bit (the contract that keeps the engines from drifting);
+    - **the big config** (1M partitions x 1k nodes on device hosts,
+      smoke sizes on CPU): shortlist build + converged sparse solve
+      timed end-to-end with the full audit, WITHOUT materializing any
+      dense [P, S, N] score tensor;
+    - **peak-bytes evidence**: the AOT memory analysis of the compiled
+      sparse program vs the dense matrix engine's projected [P, N]
+      working set (plan.tensor.projected_score_bytes) — the number the
+      dense-memory guard refuses past budget.
+    """
+    import jax
+    import jax.numpy as jnp
+    from blance_tpu.obs import device as obs_device
+    from blance_tpu.obs import get_recorder
+    from blance_tpu.core.shortlist import auto_shortlist_k, build_shortlist
+    from blance_tpu.ops.sparse2 import (
+        sparse_min2_reference, sparse_priced_min2)
+    from blance_tpu.plan.tensor import (
+        _solve_sparse_converged_impl, projected_score_bytes,
+        resolve_sparse_impl, solve_dense_converged, solve_sparse)
+
+    rec = get_recorder()
+    out = {"P": P, "N": N}
+
+    # Kernel verification (compiled on TPU, interpret elsewhere): the
+    # fused sparse min2 must match its XLA oracle bit-for-bit before any
+    # timed run uses it.
+    rng = np.random.default_rng(11)
+    score = jnp.asarray(
+        rng.integers(0, 50, (2048, 64)).astype(np.float32) * 0.125)
+    price = jnp.asarray(
+        rng.integers(0, 8, (2048, 64)).astype(np.float32) * 0.25)
+    impl = resolve_sparse_impl(None)
+    kb, kk_, ks, kr = sparse_priced_min2(
+        score, price, interpret=(impl != "pallas"))
+    rb, rk, rs, rr = sparse_min2_reference(score, price)
+    out["kernel_verified"] = bool(
+        np.array_equal(np.asarray(kb), np.asarray(rb))
+        and np.array_equal(np.asarray(kk_), np.asarray(rk))
+        and np.array_equal(np.asarray(ks), np.asarray(rs))
+        and np.array_equal(np.asarray(kr), np.asarray(rr)))
+    log(f"[sparse] min2 kernel ({impl}) vs oracle: "
+        f"{'bit-identical' if out['kernel_verified'] else 'MISMATCH'}")
+
+    # Saturating-K bit-identity at a dense-feasible shape.
+    ip, inn = identity_shape
+    (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+     constraints, rules) = build_dense(ip, inn, seed=13)
+    dev = [jnp.asarray(a) for a in
+           (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+    dense_small = np.asarray(solve_dense_converged(
+        *dev, constraints, rules, record=False))
+    sparse_small = solve_sparse(prev, pweights, nweights, valid,
+                                stickiness, gids, gid_valid, constraints,
+                                rules, k=inn, record=False)
+    out["saturating_identity"] = bool(
+        np.array_equal(dense_small, sparse_small))
+    log(f"[sparse] saturating K={inn} identity @ {ip}x{inn}: "
+        f"{out['saturating_identity']}")
+
+    # The big config: never materializes a dense [P, S, N] score.
+    (prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+     constraints, rules) = build_dense(P, N, seed=7)
+    kk = int(k) if k is not None else auto_shortlist_k(
+        N, constraints, rules)
+    out["k"] = kk
+
+    t0 = time.perf_counter()
+    shortlist = build_shortlist(prev, pweights, nweights, valid, gids,
+                                gid_valid, constraints, rules, kk)
+    np.asarray(shortlist[:, 0])  # force completion
+    out["shortlist_build_s"] = round(time.perf_counter() - t0, 3)
+
+    dev = [jnp.asarray(a) for a in
+           (prev, pweights, nweights, valid, stickiness, gids, gid_valid)]
+    impl_big = resolve_sparse_impl(None)
+
+    def run():
+        a, sweeps, exh = _solve_sparse_converged_impl(
+            *dev, shortlist, constraints=constraints, rules=rules,
+            sparse_impl=impl_big)
+        np.asarray(a[:, 0, 0])  # force completion (axon-safe sync)
+        return a, exh
+
+    with obs_device.CompileMonitor() as mon:
+        t0 = time.perf_counter()
+        assign, exh = run()
+        out["compile_s"] = round(time.perf_counter() - t0, 2)
+        times = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            assign, exh = run()
+            times.append(time.perf_counter() - t0)
+    out["solve_ms_min"] = round(min(times) * 1000, 2)
+    out["solve_ms_runs"] = [round(t * 1000, 2) for t in times]
+    out["exhausted_rows"] = int(np.asarray(exh).sum())
+    counts = audit(np.asarray(assign), valid, gids)
+    # Exhausted rows are -1 by design until the host fallback fills
+    # them; audit the FINAL (fallback-patched) assignment.
+    if out["exhausted_rows"]:
+        from blance_tpu.plan.tensor import _apply_sparse_fallback
+
+        patched, _ = _apply_sparse_fallback(
+            np.asarray(assign), np.asarray(exh), prev, pweights,
+            nweights, valid, stickiness, gids, gid_valid, constraints,
+            rules)
+        counts = audit(patched, valid, gids)
+    out["violations"] = counts
+    out["device"] = _device_block(mon)
+
+    # Peak-bytes evidence: AOT memory analysis of the compiled sparse
+    # program vs the dense matrix estimate.
+    out["dense_score_bytes_est"] = projected_score_bytes(P, N)
+    try:
+        lowered = _solve_sparse_converged_impl.lower(
+            *dev, shortlist, constraints=constraints, rules=rules,
+            sparse_impl=impl_big)
+        peak = obs_device._extract_cost(lowered.compile())[
+            "peak_alloc_bytes"]
+        out["sparse_peak_bytes"] = int(peak)
+        if peak:
+            out["sparse_vs_dense_bytes"] = round(
+                peak / max(out["dense_score_bytes_est"], 1), 4)
+    except Exception as e:
+        out["sparse_peak_bytes_error"] = first_line(e)
+    log(f"[sparse {P}x{N}] K={kk} build {out['shortlist_build_s']}s "
+        f"solve min {out['solve_ms_min']}ms exhausted "
+        f"{out['exhausted_rows']} audit {counts} peak "
+        f"{out.get('sparse_peak_bytes')}B vs dense est "
+        f"{out['dense_score_bytes_est']}B")
+    assert counts["unassigned_slots"] == 0
+    assert counts["on_removed_nodes"] == 0
+    return out
+
+
 def obs_summary():
     """The Recorder's aggregates, floats rounded for the JSON artifact:
     per-span-name totals (phase attribution), counters (solver sweeps,
@@ -1486,19 +1628,40 @@ def _run_perf_smoke():
         pipe_ok = False
     ok = ok and pipe_ok
 
+    # Sparse gate (ISSUE 11): saturating-K bit-identity must hold, the
+    # sparse min2 kernel must match its oracle, the audit at the large
+    # smoke config must be clean, and the compiled sparse program's AOT
+    # peak bytes must sit below the dense matrix engine's projected
+    # [P, N] working set (the memory the dense guard refuses) — so the
+    # "breaks the dense wall" claim is CI-checked, not aspirational.
+    try:
+        sparse = bench_sparse(4096, 256)
+        sparse_ok = (sparse["saturating_identity"]
+                     and sparse["kernel_verified"]
+                     and not any(sparse["violations"].values()))
+        peak = sparse.get("sparse_peak_bytes")
+        if peak:
+            sparse_ok = sparse_ok and \
+                peak < sparse["dense_score_bytes_est"]
+    except AssertionError as e:
+        sparse = {"error": first_line(e)}
+        sparse_ok = False
+    ok = ok and sparse_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
         "unit": "sweeps",
         "vs_baseline": res["cold_sweeps"],
-        "detail": {**res, "pipeline": pipe},
+        "detail": {**res, "pipeline": pipe, "sparse": sparse},
         "pass": ok,
     }))
     if not ok:
         log(f"PERF-SMOKE FAILED: warm={res['warm_sweeps']} sweeps vs "
             f"cold={res['cold_sweeps']} (hit={res['warm_carry_hit']}, "
             f"identical={res['identical']}); pipeline "
-            f"{'OK' if pipe_ok else f'FAILED: {pipe}'}")
+            f"{'OK' if pipe_ok else f'FAILED: {pipe}'}; sparse "
+            f"{'OK' if sparse_ok else f'FAILED: {sparse}'}")
         sys.exit(1)
 
 
@@ -1693,6 +1856,20 @@ def _run_benchmarks(smoke, backend_note=None):
             f"({type(e).__name__}: {first_line(e)})")
         detail["plan_pipeline_error"] = first_line(e)
     save_progress(detail, "plan-pipeline done")
+
+    # Sparse stage: the shortlist engine at the million-partition config
+    # (ISSUE 11) — saturating-K bit-identity, the 1M x 1k solve with no
+    # dense [P, S, N] score tensor, and AOT peak-bytes vs the dense
+    # estimate.  Smoke sizes on cpu hosts.
+    try:
+        sp, sn = (4096, 128) if smoke else (1_000_000, 1_000)
+        detail["sparse"] = bench_sparse(sp, sn)
+    except AssertionError:
+        raise  # a failed sparse audit is a correctness regression
+    except Exception as e:  # must not eat the solve numbers
+        log(f"sparse stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["sparse_error"] = first_line(e)
+    save_progress(detail, "sparse done")
 
     # Fleet stage: 64 small tenant indexes solved per-tenant (the loop a
     # fleet replan runs today) vs batched by bucket class through the

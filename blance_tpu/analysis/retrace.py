@@ -46,6 +46,13 @@ RETRACE_BUDGETS: dict[str, int] = {
     "solve_dense.carry": 2,
     "solve_dense.warm": 2,
     "solve_dense.bucketed": 2,
+    # Sparse shortlist solve: the cold entry owns TWO programs (the
+    # jitted shortlist builder + the converged sparse fixpoint), each
+    # dispatched 4x at one (shape, K) — a per-call retrace more than
+    # doubles the count.  The warm entry reuses the builder's cache
+    # entry and compiles only the repair program.
+    "sparse.cold": 3,
+    "sparse.warm": 2,
     "fleet.cold": 3,
     "fleet.warm": 3,
     # The shard_map dispatch legitimately compiles many sub-programs
@@ -146,6 +153,28 @@ def _workload() -> None:
             "primary": [nodes[i % n_real]],
             "replica": [nodes[(i + 1) % n_real]]}) for i in range(24)}
         plan_next_map_tpu(pmap, pmap, nodes, [], [], m, opts)
+
+    # sparse.cold + sparse.warm — the shortlist engine at one
+    # (shape, K): four cold dispatches (builder + fixpoint compile once,
+    # calls 2..4 ride the jit cache), then four warm one-sweep repairs
+    # consuming a fresh carry each (the carry is single-use by
+    # contract, like the dense warm loop above).
+    from ..plan.tensor import solve_sparse, solve_sparse_warm
+
+    s_out = solve_sparse(prev, pw, nw, valid, stick, gids, gv,
+                         constraints, rules, k=4, record=False)
+    for _ in range(3):
+        solve_sparse(prev, pw, nw, valid, stick, gids, gv,
+                     constraints, rules, k=4, record=False)
+    s_cur = s_out
+    for _ in range(4):
+        s_carry = carry_from_assignment(
+            jnp.asarray(s_cur), dev[1], dev[2])
+        s_res, _nc = solve_sparse_warm(
+            s_cur, pw, nw, valid, stick, gids, gv, constraints, rules,
+            dirty=dirty, carry=s_carry, k=4, record=False)
+        if s_res is not None:
+            s_cur = s_res
 
     # fleet.cold + fleet.warm — two dispatches per mode, one class.
     def tenant(i, carry=None, dirty=None):

@@ -26,6 +26,13 @@ Covered entry points (acceptance contract):
   ``_pipeline_warm_impl`` (cold/carry/bucketed/warm: the one-dispatch
   solve→diff→pack programs), plus both under ``shard_map`` with specs
   derived from ``parallel/sharded``'s declarative layout tables
+- sparse shortlist solve     — ``plan.tensor._solve_sparse_converged_impl``
+  (cold + carry: (assign, sweeps, exhausted)), ``_warm_repair_sparse``
+  ((assign, used, ok, exhausted)), the same body under ``shard_map``
+  with specs from ``SOLVER_IN_LAYOUT + SPARSE_EXTRA_LAYOUT``, the
+  shortlist builder (``core.shortlist.build_shortlist_core``: [P, K]
+  int32, saturating K -> [P, N]), and a concrete host-side check of the
+  per-row dense exhaustion fallback (fills flagged rows audit-clean)
 - carry construction         — ``carry_from_assignment`` / ``_carry_used_jit``
 - ``encode_problem`` / ``decode_assignment`` — dense-encoding dtypes and
   the decode round trip (tiny concrete problem; host-only, milliseconds)
@@ -343,6 +350,119 @@ def _build_pipeline_sharded(d: Dims, warm: bool = False):
     return fn, _solver_args(d, jnp) + extra, {}
 
 
+def _sparse_k(d: Dims) -> int:
+    """A K < N candidate width for the sparse contracts (saturation is
+    covered separately by the builder contract)."""
+    return max(1, min(d.N - 1, d.R + 2))
+
+
+def _build_sparse_cold(d: Dims, carry: bool = False):
+    import numpy as np
+
+    from ..plan.tensor import _solve_sparse_converged_impl
+
+    args = _solver_args(d, None) + (
+        _sds((d.P, _sparse_k(d)), np.int32),)  # shortlist
+    kwargs = {"constraints": d.constraints, "rules": d.rules,
+              "max_iterations": 4, "sparse_impl": "xla"}
+    if carry:
+        kwargs["carry_used"] = _sds((d.S, d.N), np.float32)
+    return _solve_sparse_converged_impl, args, kwargs
+
+
+def _expect_sparse_cold(d: Dims):
+    import numpy as np
+
+    return (_expect_assign(d), ((), "int32"), ((d.P,), np.bool_))
+
+
+def _build_sparse_warm(d: Dims):
+    import numpy as np
+
+    from ..plan.tensor import _warm_repair_sparse
+
+    args = _solver_args(d, None) + (
+        _sds((d.P, _sparse_k(d)), np.int32),  # shortlist
+        _sds((d.P,), np.bool_),  # dirty
+        _sds((d.S, d.N), np.float32),  # carry_used
+    )
+    return _warm_repair_sparse, args, {
+        "constraints": d.constraints, "rules": d.rules,
+        "sparse_impl": "xla"}
+
+
+def _expect_sparse_warm(d: Dims):
+    import numpy as np
+
+    return (_expect_assign(d), _expect_used(d), ((), "bool"),
+            ((d.P,), np.bool_))
+
+
+def _build_sparse_sharded(d: Dims):
+    """The sparse converged solve under shard_map, in/out specs from
+    the runtime's declarative layout tables (SPARSE_EXTRA_LAYOUT /
+    SPARSE_COLD_OUT_LAYOUT) — the exact dispatch solve_sparse_sharded
+    builds."""
+    from functools import partial
+
+    import numpy as np
+
+    import jax
+
+    from ..parallel.sharded import (
+        PARTITION_AXIS,
+        SOLVER_IN_LAYOUT,
+        SPARSE_COLD_OUT_LAYOUT,
+        SPARSE_EXTRA_LAYOUT,
+        _build_checked,
+        _shard_map,
+        layout_specs,
+        make_mesh,
+    )
+    from ..plan.tensor import _solve_sparse_converged_impl
+
+    n_dev = len(jax.devices())
+    shards = n_dev if d.P % n_dev == 0 else 1
+    mesh = make_mesh(shards)
+    body = partial(_solve_sparse_converged_impl,
+                   constraints=d.constraints, rules=d.rules,
+                   axis_name=PARTITION_AXIS, max_iterations=4,
+                   sparse_impl="xla")
+    sm = partial(_shard_map, body, mesh=mesh,
+                 in_specs=layout_specs(SOLVER_IN_LAYOUT
+                                       + SPARSE_EXTRA_LAYOUT),
+                 out_specs=layout_specs(SPARSE_COLD_OUT_LAYOUT))
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    fn = _build_checked(sm, has_vma)
+    return fn, _solver_args(d, None) + (
+        _sds((d.P, _sparse_k(d)), np.int32),), {}
+
+
+def _build_shortlist_builder(d: Dims, saturating: bool = False):
+    import numpy as np
+
+    from ..core.shortlist import build_shortlist_core
+
+    k = d.N + 2 if saturating else _sparse_k(d)
+    args = (
+        _sds((d.P, d.S, d.R), np.int32),  # prev
+        _sds((d.P,), np.float32),  # pweights
+        _sds((d.N,), np.float32),  # nweights
+        _sds((d.N,), np.bool_),  # valid
+        _sds((d.L, d.N), np.int32),  # gids
+        _sds((d.L, d.N), np.bool_),  # gid_valid
+    )
+    return build_shortlist_core, args, {
+        "constraints": d.constraints, "rules": d.rules, "k": k}
+
+
+def _expect_shortlist(d: Dims, saturating: bool = False):
+    import numpy as np
+
+    k = d.N if saturating else _sparse_k(d)
+    return ((d.P, k), np.int32)
+
+
 def _bucketed_dims(d: Dims) -> Dims:
     from ..core.encode import bucket_size
 
@@ -501,6 +621,44 @@ CONTRACTS: tuple[ShapeContract, ...] = tuple(
             variant=f"B{_FLEET_B}@{d.P}x{d.N}",
             build=(lambda d=d: _build_fleet_warm(d)),
             expect=(lambda d=d: _expect_fleet_warm(d)))
+        for d in _MATRIX
+    ] + [
+        # -- sparse shortlist solve (ISSUE 11) -------------------------
+        ShapeContract(
+            entry="solve_sparse", variant=f"cold@{d.P}x{d.N}",
+            build=(lambda d=d: _build_sparse_cold(d)),
+            expect=(lambda d=d: _expect_sparse_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_sparse", variant=f"carry@{d.P}x{d.N}",
+            build=(lambda d=d: _build_sparse_cold(d, carry=True)),
+            expect=(lambda d=d: _expect_sparse_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_sparse_warm", variant=f"repair@{d.P}x{d.N}",
+            build=(lambda d=d: _build_sparse_warm(d)),
+            expect=(lambda d=d: _expect_sparse_warm(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="solve_sparse_sharded", variant=f"1d@{d.P}x{d.N}",
+            build=(lambda d=d: _build_sparse_sharded(d)),
+            expect=(lambda d=d: _expect_sparse_cold(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="build_shortlist", variant=f"topk@{d.P}x{d.N}",
+            build=(lambda d=d: _build_shortlist_builder(d)),
+            expect=(lambda d=d: _expect_shortlist(d)))
+        for d in _MATRIX
+    ] + [
+        ShapeContract(
+            entry="build_shortlist", variant=f"saturating@{d.P}x{d.N}",
+            build=(lambda d=d: _build_shortlist_builder(
+                d, saturating=True)),
+            expect=(lambda d=d: _expect_shortlist(d, saturating=True)))
         for d in _MATRIX
     ] + [
         # -- fused single-dispatch plan pipeline (solve→diff→pack) -----
@@ -718,6 +876,63 @@ def _check_bucketing_algebra() -> list[Finding]:
     return findings
 
 
+def _check_sparse_fallback() -> list[Finding]:
+    """Concrete host contract of the per-row dense exhaustion fallback:
+    a row flagged exhausted (its shortlist was all removed nodes) must
+    come back with every feasible slot filled, duplicate-free, off
+    removed nodes, and untouched rows bit-unchanged.  Tiny problem,
+    host + one small solve, milliseconds."""
+    import numpy as np
+
+    findings: list[Finding] = []
+    label = "sparse_fallback"
+    try:
+        from ..plan.tensor import _sparse_fallback_rows
+
+        P, S, R, N = 6, 2, 1, 8
+        rng = np.random.default_rng(5)
+        prev = np.full((P, S, R), -1, np.int32)
+        prev[:, 0, 0] = rng.integers(0, N, P)
+        prev[:, 1, 0] = (prev[:, 0, 0] + 1) % N
+        assign = prev.copy()
+        assign[0] = -1  # the exhausted row the sparse solve left empty
+        valid = np.ones(N, bool)
+        valid[prev[0, 0, 0]] = False
+        gids = np.stack([np.arange(N, dtype=np.int32),
+                         np.arange(N, dtype=np.int32) // 2,
+                         np.zeros(N, np.int32)])
+        out = _sparse_fallback_rows(
+            assign, np.array([0]), prev, np.ones(P, np.float32),
+            np.ones(N, np.float32), valid,
+            np.full((P, S), 1.5, np.float32), gids,
+            np.ones((3, N), bool), (1, 1), ((), ((2, 1),)))
+        row = out[0]
+        if (row < 0).any():
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"fallback left feasible slots empty: {row}"))
+        held = row[row >= 0]
+        if held.size and (~valid[held]).any():
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message="fallback placed a copy on a removed node"))
+        if held.size != np.unique(held).size:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"fallback duplicated a node in one row: {row}"))
+        if not np.array_equal(out[1:], assign[1:]):
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message="fallback mutated rows it was not asked to"))
+    except Exception as e:
+        first = (str(e).splitlines() or [""])[0][:200]
+        findings.append(Finding(
+            rule="SHP002", path=_PATH, line=0, symbol=label,
+            message=f"sparse fallback audit raised "
+                    f"({type(e).__name__}: {first})"))
+    return findings
+
+
 def run_shape_audit() -> tuple[list[Finding], int]:
     """Run the whole table.  Returns (findings, entries_checked)."""
     findings: list[Finding] = []
@@ -725,4 +940,5 @@ def run_shape_audit() -> tuple[list[Finding], int]:
         findings.extend(_check_one(contract))
     findings.extend(_check_encode_decode())
     findings.extend(_check_bucketing_algebra())
-    return findings, len(CONTRACTS) + 2
+    findings.extend(_check_sparse_fallback())
+    return findings, len(CONTRACTS) + 3

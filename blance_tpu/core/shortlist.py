@@ -1,0 +1,272 @@
+"""Per-partition top-K candidate shortlists for the sparse solver.
+
+The dense auction scores every partition against every node — an
+f32 [P, N] sweep whose memory wall blocks the next order of magnitude
+(ROADMAP item 2: 1M x 10k is a ~40 GB score tensor).  But the candidate
+set compresses dramatically (arxiv 2510.12196): stickiness makes most
+partitions' viable rows near-diagonal (their previous nodes), hierarchy
+rules confine replicas to a handful of groups, and balance pressure only
+ever pulls load toward the emptiest nodes.  Following TOAST
+(arxiv 2508.15010), the shortlist is derived STATICALLY from the
+constraint structure before the sweep, not re-discovered per round:
+
+1. **Sticky candidates** — every node the partition currently holds
+   (prev[P, S, R]): the warm-carry steady state re-pins these, so they
+   must always be in reach.
+2. **Rule-group representatives** — per hierarchy rule (include,
+   exclude) with exclude strictly finer than include (the nesting tree
+   shape the solver's sparse path requires): the least-loaded valid
+   node of each exclude-group ("rack") is that group's representative;
+   each partition gets the ``reps`` least-loaded representatives inside
+   its previous primary's include-group ("zone"), so a rule-satisfying
+   target exists for every replica ordinal without scanning N columns.
+3. **Global attractors + coverage** — a few globally least-loaded valid
+   nodes by weight-normalized seed fill, shared by every row (fresh or
+   empty nodes must attract load from every partition), plus a per-row
+   rotated window over the valid-node ranking so unanchored rows (a
+   fresh cluster) collectively cover all N nodes instead of herding
+   onto one shared top-K.
+
+Priority is exactly that order: when the union exceeds K, attractors are
+dropped first and sticky candidates never.  Rows are deduplicated and
+returned sorted ascending with -1 padding at the tail — ascending order
+is what makes a saturating K = N shortlist the identity permutation, so
+the sparse solve's tie-breaks match the dense engine's lowest-node-id
+rule bit-for-bit.
+
+The builder is a pure jittable array program (`build_shortlist_core`)
+so the fused sparse plan pipeline can run it INSIDE its single device
+dispatch; `build_shortlist` is the host-facing jitted spelling.
+
+A shortlist is a HINT, not a correctness surface: the sparse solver
+detects rows whose shortlist cannot reach the globally attainable rule
+tier (or has no feasible candidate at all) and routes them through the
+per-row dense fallback, so audit contracts hold for ANY shortlist — the
+builder only controls how rarely that escape hatch fires.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+__all__ = ["auto_shortlist_k", "build_shortlist", "build_shortlist_core",
+           "shortlist_rules_nest"]
+
+
+def shortlist_rules_nest(rules: tuple) -> bool:
+    """True when every rule's exclude level is strictly finer than its
+    include level — the tree shape the sparse solver's group-counting
+    tier floor (and rule step 2 above) requires."""
+    return all(exc < inc for state_rules in rules
+               for (inc, exc) in state_rules)
+
+
+def auto_shortlist_k(n: int, constraints: tuple, rules: tuple) -> int:
+    """Default K for an (N, constraints, rules) problem.
+
+    Sized to cover the sticky set (every held slot), a rule
+    representative per constrained ordinal of every rule-bearing state,
+    and a margin of global attractors — then rounded up to a lane-
+    friendly multiple of 8 and clamped to N.  Guidance (docs/DESIGN.md
+    "Sparse solve"): raise K when exhaustion counters
+    (``plan.sparse.shortlist_exhausted``) are nonzero in steady state;
+    lower it toward this floor when they stay at zero.
+    """
+    slots = sum(max(int(c), 0) for c in constraints)
+    ruled = sum(max(int(c), 0) for c, state_rules in zip(constraints, rules)
+                if state_rules)
+    k = 2 * slots + 2 * ruled + 8
+    k = max(16, k)
+    k = -(-k // 8) * 8
+    return min(max(n, 1), k)
+
+
+def _seed_load(prev, pweights, nweights, n: int):
+    """[N] weight-normalized seed fill from the previous placement — the
+    same quantity the solver's balance term divides, so 'least loaded'
+    here agrees with where the auction will push load."""
+    import jax.numpy as jnp
+
+    ids = prev.reshape(prev.shape[0], -1)
+    w = jnp.broadcast_to(pweights[:, None], ids.shape).reshape(-1)
+    flat = jnp.where(ids >= 0, ids, n).reshape(-1)
+    fill = jnp.zeros(n, jnp.float32).at[flat].add(w, mode="drop")
+    w_div = jnp.where(nweights > 0, nweights, 1.0)
+    return fill / w_div
+
+
+def _group_reps(load_rank, gids_lv, gid_valid_lv, valid, n: int):
+    """[N] exclude-group -> representative node id (-1 = empty group):
+    the valid node with the best (lowest) load rank in each group.
+    Group ids are dense per level (< N), so the table is [N]-shaped."""
+    import jax.numpy as jnp
+
+    ok = valid & gid_valid_lv & (gids_lv >= 0)
+    g = jnp.where(ok, gids_lv, n)
+    rank = jnp.where(ok, load_rank, n)
+    best = jnp.full(n, n, jnp.int32).at[g].min(
+        rank.astype(jnp.int32), mode="drop")
+    # Invert: node whose rank equals its group's best wins (ranks are a
+    # permutation, so the hit is unique).
+    node_of_rank = jnp.full(n + 1, -1, jnp.int32).at[
+        jnp.clip(rank.astype(jnp.int32), 0, n)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return jnp.where(best < n, node_of_rank[jnp.clip(best, 0, n)], -1)
+
+
+def _rep_table(rep, load_rank, gids_inc, gid_valid_inc, m: int, n: int):
+    """[N, m] include-group -> its ``m`` best exclude-group
+    representatives (by load rank, -1 padded).
+
+    Built by sorting exclude groups by (include parent of their rep,
+    rep's load rank) and scattering the first m of each segment.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    has = rep >= 0
+    rep_c = jnp.clip(rep, 0, n - 1)
+    parent = jnp.where(has & gid_valid_inc[rep_c], gids_inc[rep_c], n)
+    rank = jnp.where(has, load_rank[rep_c], n).astype(jnp.int32)
+    # Sort exclude groups by rep rank, then stable-group by parent:
+    # within a parent, reps come out least-loaded first.
+    perm1 = jnp.argsort(rank, stable=True)
+    perm = perm1[jnp.argsort(parent[perm1], stable=True)]
+    parent_s = parent[perm]
+    rep_s = rep[perm]
+    seg_start = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), parent_s[1:] != parent_s[:-1]])
+    pos_all = jnp.arange(n, dtype=jnp.int32)
+    seg_base = lax.cummax(jnp.where(seg_start, pos_all, -1))
+    segpos = pos_all - seg_base
+    ok = (parent_s < n) & (rep_s >= 0) & (segpos < m)
+    flat_idx = jnp.where(ok, parent_s * m + segpos, n * m)
+    return jnp.full(n * m, -1, jnp.int32).at[flat_idx].set(
+        rep_s, mode="drop").reshape(n, m)
+
+
+def _dedup_truncate_sort(cand, k: int, n: int):
+    """[P, C] priority-ordered candidate ids -> [P, k] deduplicated,
+    ascending, -1-padded shortlist.  Keep-first dedup: earlier columns
+    (higher priority) survive, so sticky candidates never drop."""
+    import jax.numpy as jnp
+
+    c_width = cand.shape[1]
+    ids = jnp.where(cand >= 0, cand, n)  # absent -> sentinel n
+    # Stable id sort keeps original column order (= priority) inside
+    # duplicate runs, so the first kept copy is the highest-priority one.
+    ord1 = jnp.argsort(ids, axis=1, stable=True)
+    ids_s = jnp.take_along_axis(ids, ord1, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((ids.shape[0], 1), jnp.bool_),
+         (ids_s[:, 1:] == ids_s[:, :-1]) & (ids_s[:, 1:] < n)], axis=1)
+    # Rank survivors by priority; dups/sentinels sink past every real
+    # column and are truncated with the overflow.
+    key = jnp.where(dup | (ids_s >= n), c_width, ord1)
+    ord2 = jnp.argsort(key, axis=1, stable=True)
+    kept = jnp.take_along_axis(ids_s, ord2, axis=1)[:, :k]
+    kept_key = jnp.take_along_axis(key, ord2, axis=1)[:, :k]
+    kept = jnp.where(kept_key >= c_width, n, kept)
+    out = jnp.sort(kept, axis=1)  # ascending; sentinels sink to the tail
+    return jnp.where(out >= n, -1, out).astype(jnp.int32)
+
+
+def build_shortlist_core(prev, pweights, nweights, valid, gids, gid_valid,
+                         constraints: tuple, rules: tuple, k: int,
+                         reps: Optional[int] = None):
+    """Traceable builder core: [P, S, R] placement -> [P, K'] shortlist
+    (K' = min(k, N)); see the module docstring for the derivation.
+
+    ``k``/``reps`` are static.  Saturating K >= N returns the identity
+    permutation broadcast to every row — the spelling that makes the
+    sparse solve bit-identical to the dense one.
+    """
+    import jax.numpy as jnp
+
+    p = prev.shape[0]
+    n = nweights.shape[0]
+    if n == 0 or p == 0:
+        return jnp.zeros((p, 0), jnp.int32)
+    if k >= n:
+        return jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (p, n))
+    k = max(int(k), 1)
+
+    load = _seed_load(prev, pweights, nweights, n)
+    # Global least-loaded ranking; ties break by node id (stable sort).
+    order = jnp.argsort(jnp.where(valid, load, jnp.inf),
+                        stable=True).astype(jnp.int32)
+    load_rank = jnp.zeros(n, jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+    cols = [prev.reshape(p, -1)]  # sticky candidates, highest priority
+
+    if reps is None:
+        reps = max([1] + [int(c) + 1 for c, state_rules
+                          in zip(constraints, rules) if state_rules])
+        reps = min(reps, max(1, k // 2))
+    anchor = prev[:, 0, 0]
+    anchor_c = jnp.clip(anchor, 0, n - 1)
+    seen: set = set()
+    for state_rules in rules:
+        for (inc, exc) in state_rules:
+            if (inc, exc) in seen or not (exc < inc):
+                continue
+            seen.add((inc, exc))
+            rep = _group_reps(load_rank, gids[exc], gid_valid[exc],
+                              valid, n)
+            table = _rep_table(rep, load_rank, gids[inc], gid_valid[inc],
+                               reps, n)
+            g = jnp.where((anchor >= 0) & gid_valid[inc][anchor_c],
+                          gids[inc][anchor_c], -1)
+            row_reps = jnp.where(
+                (g[:, None] >= 0),
+                table[jnp.clip(g, 0, n - 1)], -1)
+            cols.append(row_reps)
+
+    n_fixed = sum(c.shape[1] for c in cols)
+    k_glob = max(k - min(n_fixed, k - 1), 1)
+    # Global attractors split two ways.  A few TRUE least-loaded nodes,
+    # shared by every row: a fresh/empty node must attract load from
+    # everyone.  The rest is a per-row ROTATED window over the valid-node
+    # ranking (Weyl-hash offset): identical windows would herd every
+    # unanchored row (a fresh cluster: no sticky nodes, no rule anchors)
+    # onto the same K nodes and leave the force step to cram them past
+    # the capacity rail — coverage, not just greed, is what lets the
+    # auction's price/rail spread fresh load across all N nodes.
+    g_top = min(4, k_glob)
+    cols.append(jnp.broadcast_to(order[:g_top], (p, g_top)))
+    k_cov = k_glob - g_top
+    if k_cov > 0:
+        n_valid = jnp.maximum(
+            jnp.sum(valid.astype(jnp.int32)), jnp.int32(1))
+        rowpos = (jnp.arange(p, dtype=jnp.int32) * jnp.int32(40503)) \
+            % n_valid
+        offs = rowpos[:, None] + jnp.arange(k_cov, dtype=jnp.int32)[None, :]
+        cols.append(order[offs % n_valid])
+
+    cand = jnp.concatenate(cols, axis=1)
+    return _dedup_truncate_sort(cand, k, n)
+
+
+_STATICS = ("constraints", "rules", "k", "reps")
+_build_jit = None
+
+
+def build_shortlist(prev, pweights, nweights, valid, gids, gid_valid,
+                    constraints: tuple, rules: tuple, k: int,
+                    reps: Optional[int] = None):
+    """Host-facing jitted spelling of :func:`build_shortlist_core`."""
+    global _build_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _build_jit is None:
+        _build_jit = partial(jax.jit, static_argnames=_STATICS)(
+            build_shortlist_core)
+    return _build_jit(
+        jnp.asarray(prev), jnp.asarray(pweights), jnp.asarray(nweights),
+        jnp.asarray(valid), jnp.asarray(gids), jnp.asarray(gid_valid),
+        constraints=tuple(constraints),
+        rules=tuple(tuple(r) for r in rules), k=int(k),
+        reps=None if reps is None else int(reps))

@@ -168,6 +168,22 @@ class PlanOptions:
     # stripped before decode.  Off by default: one-shot callers pay the
     # up-to-12.5% padded-FLOPs cost for no reuse benefit.
     shape_bucketing: bool = False
+    # Sparse shortlist solver (plan/tensor.solve_sparse): score only a
+    # per-partition top-K candidate node list (derived from current
+    # placement, hierarchy groups and weights — core/shortlist.py)
+    # instead of the dense [P, N] sweep, with fill/price tables kept at
+    # full [S, N] width and a per-row dense fallback for exhausted
+    # shortlists.  True forces it (requires nesting hierarchy rules:
+    # exclude_level < include_level), False forbids it, None = auto —
+    # sparse exactly when the dense matrix engine's projected score
+    # footprint exceeds the device memory budget.  With a saturating
+    # K >= N the sparse result is bit-identical to the dense one.
+    sparse: Optional[bool] = None
+    # Candidate columns per partition for the sparse solver; None =
+    # auto-sized from the constraint structure (core/shortlist.py
+    # auto_shortlist_k).  Raise it when plan.sparse.shortlist_exhausted
+    # stays nonzero in steady state (docs/DESIGN.md "Sparse solve").
+    sparse_k: Optional[int] = None
     # Opt-in fused plan pipeline for the tpu backend: chain
     # encode→solve→move-diff→decode-pack through ONE jitted,
     # buffer-donated device dispatch (plan/tensor.plan_pipeline) instead

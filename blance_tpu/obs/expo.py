@@ -188,6 +188,21 @@ def default_registry() -> MetricsRegistry:
         Metric("plan.pipeline.dispatch_s", "histogram",
                "wall-clock seconds per fused pipeline device dispatch "
                "(solve + diff + pack, one program)"),
+        # -- sparse shortlist solver (plan/tensor.solve_sparse +
+        # core/shortlist.py + parallel/sharded.solve_sparse_sharded) ----------
+        Metric("plan.sparse.shortlist_build_s", "histogram",
+               "seconds to derive the per-partition top-K candidate "
+               "shortlist (host entries; the fused sparse pipeline "
+               "builds it in-dispatch instead)"),
+        Metric("plan.sparse.k_effective", "gauge",
+               "candidate columns per partition (K) of the most recent "
+               "sparse solve"),
+        Metric("plan.sparse.shortlist_exhausted", "counter",
+               "partitions flagged by the sparse solve with no "
+               "acceptable shortlist candidate for some slot"),
+        Metric("plan.sparse.dense_fallback_rows", "counter",
+               "exhausted partitions re-placed by the per-row dense "
+               "fallback"),
         Metric("plan.greedy.candidates", "histogram",
                "candidates scored per greedy (partition, state) pick"),
         # -- moves -----------------------------------------------------------

@@ -35,12 +35,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.encode import DenseProblem, pad_to
 from ..plan.tensor import (
     SolveCarry,
+    _apply_sparse_fallback,
     _pipeline_cold_impl,
     _pipeline_warm_impl,
     _record_sweeps,
+    _solve_sparse_converged_impl,
     _warm_repair,
+    _warm_repair_sparse,
     carry_from_assignment,
+    resolve_sparse_impl,
     solve_dense_converged,
+    sparse_rules_supported,
 )
 from ..obs import device as _obs_device
 from ..obs import get_recorder
@@ -56,8 +61,9 @@ except AttributeError:  # older jax (e.g. 0.4.x)
 __all__ = ["make_mesh", "make_mesh_2d", "make_hybrid_mesh",
            "make_mesh_auto", "mesh_shape_for", "slice_major_order",
            "solve_dense_sharded", "solve_pipeline_sharded",
+           "solve_sparse_sharded",
            "pad_partitions", "pad_nodes", "SOLVER_IN_LAYOUT",
-           "WARM_EXTRA_LAYOUT", "layout_specs"]
+           "WARM_EXTRA_LAYOUT", "SPARSE_EXTRA_LAYOUT", "layout_specs"]
 
 PARTITION_AXIS = "parts"
 NODE_AXIS = "nodes"
@@ -85,6 +91,23 @@ SOLVER_IN_LAYOUT: tuple[tuple[str, str], ...] = (
 WARM_EXTRA_LAYOUT: tuple[tuple[str, str], ...] = (
     ("dirty", "parts"),
     ("carry_used", "replicated"),
+)
+# Sparse solve: the [P, K] shortlist rides the partition axis with its
+# prev rows; every [N]-shaped table stays replicated exactly like the
+# dense layout (the sparse engine's fill/price/capacity are full-width
+# by design).
+SPARSE_EXTRA_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("shortlist", "parts"),
+)
+# Sparse solve outputs: assign + exhaustion flags are row-wise in P;
+# the executed-sweep count is globally agreed.
+SPARSE_COLD_OUT_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("assign", "parts"), ("sweeps", "replicated"),
+    ("exhausted", "parts"),
+)
+SPARSE_WARM_OUT_LAYOUT: tuple[tuple[str, str], ...] = (
+    ("assign", "parts"), ("used", "replicated"),
+    ("ok", "replicated"), ("exhausted", "parts"),
 )
 # Pipeline outputs: assign + the diff/pack tensors are row-wise in P
 # (shardable with zero collectives); the carry tables and scalars are
@@ -509,6 +532,167 @@ def solve_dense_sharded(
             assign, np.asarray(pweights, np.float32),
             np.asarray(nweights, np.float32))
     return assign
+
+
+def solve_sparse_sharded(
+    mesh: Mesh,
+    prev: np.ndarray,
+    pweights: np.ndarray,
+    nweights: np.ndarray,
+    valid: np.ndarray,
+    stickiness: np.ndarray,
+    gids: np.ndarray,
+    gid_valid: np.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    *,
+    k: Optional[int] = None,
+    shortlist: Optional[np.ndarray] = None,
+    max_iterations: int = 10,
+    sparse_impl: Optional[str] = None,
+    dirty: Optional[np.ndarray] = None,
+    carry: Optional[SolveCarry] = None,
+    return_carry: bool = False,
+    warm_only: bool = False,
+):
+    """The sparse shortlist solve under shard_map, partition axis
+    sharded — the [P, K] score tables (and the shortlist itself) ride
+    the partition axis via the declarative layout rows
+    (``SPARSE_EXTRA_LAYOUT``) while every [N]-shaped fill/price table
+    stays replicated, exactly like the dense layout.  1-D partition
+    meshes only: the shortlist already bounds the column working set,
+    so a node axis would shard kilobytes.
+
+    The shortlist is derived on the PADDED problem (pad rows are
+    weight-0 bidders with the same global candidates as the dense
+    engine's pads see), or adopted from ``shortlist`` and padded.  With
+    ``dirty`` + ``carry`` the warm one-sweep sparse repair runs first
+    under the solve_dense_sharded warm contract (``warm_only``
+    included); exhausted rows of an accepted result are re-placed by
+    the host-side per-row dense fallback, after padding strips."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_shards = axes[PARTITION_AXIS]
+    if axes.get(NODE_AXIS, 1) > 1:
+        raise ValueError(
+            "solve_sparse_sharded: node-axis meshes are not supported "
+            "(the [P, K] shortlist already bounds the column working "
+            "set); use a 1-D partition mesh")
+    p_orig = prev.shape[0]
+    from ..plan import tensor as _tensor
+
+    constraints = tuple(int(c) for c in constraints)
+    rules = tuple(tuple(r) for r in rules)
+    if not sparse_rules_supported(rules):
+        raise ValueError(
+            "sparse solve requires nesting hierarchy rules "
+            "(exclude_level < include_level); use solve_dense_sharded")
+    _tensor._check_tier_band_scale(
+        prev, pweights, nweights, valid, stickiness, constraints, rules)
+    impl = resolve_sparse_impl(sparse_impl)
+
+    prev_p = pad_partitions(np.asarray(prev), n_shards, -1)
+    pw_p = pad_partitions(np.asarray(pweights), n_shards, 0.0)
+    st_p = pad_partitions(np.asarray(stickiness), n_shards, 0.0)
+
+    rec = get_recorder()
+    sl_in = None if shortlist is None \
+        else pad_partitions(np.asarray(shortlist), n_shards, -1)
+    sl_p = _tensor._build_or_adopt_shortlist(
+        prev_p, pw_p, nweights, valid, gids, gid_valid, constraints,
+        rules, sl_in, k, True)
+
+    shard = P(PARTITION_AXIS)
+    rep = P()
+    has_vma = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    # The pallas sparse2 kernel needs the checker off for the same
+    # reason as the fused dense engine on any mesh (see
+    # solve_dense_sharded): the per-op vma propagation inside
+    # pallas_call rejects the kernel's mix of node-replicated tables
+    # and partition-varying columns, even though its outputs carry
+    # correct annotations.
+    checked_ok = has_vma and impl != "pallas"
+    device_put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    dev_args = (
+        device_put(jnp.asarray(prev_p), shard),
+        device_put(jnp.asarray(pw_p), shard),
+        device_put(jnp.asarray(nweights), rep),
+        device_put(jnp.asarray(valid), rep),
+        device_put(jnp.asarray(st_p), shard),
+        device_put(jnp.asarray(gids), rep),
+        device_put(jnp.asarray(gid_valid), rep),
+        device_put(jnp.asarray(sl_p), shard),
+    )
+
+    def finish(assign_np, exh_np, used=None):
+        """Strip padding, run the host fallback on flagged REAL rows,
+        rebuild the carry when asked (always from the final patched
+        assignment — the fallback invalidates any device-side used)."""
+        assign_np = assign_np[:p_orig]
+        patched, replaced = _apply_sparse_fallback(
+            assign_np, exh_np[:p_orig], np.asarray(prev), pweights,
+            nweights, valid, stickiness, gids, gid_valid, constraints,
+            rules)
+        if not return_carry:
+            return patched
+        if replaced or used is None:
+            return patched, carry_from_assignment(
+                patched, np.asarray(pweights, np.float32),
+                np.asarray(nweights, np.float32))
+        used_j = jnp.asarray(np.asarray(used))
+        return patched, SolveCarry(
+            prices=jnp.sum(used_j, axis=0),
+            assign=jnp.asarray(patched), used=used_j)
+
+    if dirty is not None and carry is not None:
+        dirty_p = pad_partitions(np.asarray(dirty, bool), n_shards, True)
+        cu = np.asarray(carry.used, np.float32)
+        rec.observe("plan.solve.dirty_fraction",
+                    float(np.asarray(dirty, bool).mean())
+                    if np.asarray(dirty).size else 0.0)
+        sparse_body_w = partial(
+            _warm_repair_sparse, constraints=constraints, rules=rules,
+            axis_name=PARTITION_AXIS, sparse_impl=impl)
+        sm_w = partial(
+            _shard_map, sparse_body_w, mesh=mesh,
+            in_specs=layout_specs(SOLVER_IN_LAYOUT + SPARSE_EXTRA_LAYOUT
+                                  + WARM_EXTRA_LAYOUT),
+            out_specs=layout_specs(SPARSE_WARM_OUT_LAYOUT))
+        fn_w = _build_checked(sm_w, checked_ok)
+        with rec.span("plan.solve.attempt", warm=True, sharded=True,
+                      engine="sparse"), \
+                _obs_device.entry("sparse.sharded.warm"):
+            # Same dispatch-time constant-upload exemption as the dense
+            # sharded paths (see solve_dense_sharded).
+            with jax.transfer_guard("allow"):
+                out, new_used, ok, exh = fn_w(
+                    *dev_args,
+                    device_put(jnp.asarray(dirty_p), shard),
+                    device_put(jnp.asarray(cu), rep))
+            accepted = bool(ok)
+        if accepted:
+            _record_sweeps(1)
+            rec.set_attr("warm", True)
+            return finish(np.asarray(out), np.asarray(exh), new_used)
+        rec.count("plan.solve.warm_fallback")
+        rec.count("plan.solve.sweeps", 1)  # the executed repair pass
+        if warm_only:
+            return (None, None) if return_carry else None
+
+    sparse_body = partial(
+        _solve_sparse_converged_impl, constraints=constraints,
+        rules=rules, axis_name=PARTITION_AXIS,
+        max_iterations=max_iterations, sparse_impl=impl)
+    sm = partial(
+        _shard_map, sparse_body, mesh=mesh,
+        in_specs=layout_specs(SOLVER_IN_LAYOUT + SPARSE_EXTRA_LAYOUT),
+        out_specs=layout_specs(SPARSE_COLD_OUT_LAYOUT))
+    fn = _build_checked(sm, checked_ok)
+    with rec.span("plan.solve.attempt", sharded=True, engine="sparse"), \
+            jax.transfer_guard("allow"), \
+            _obs_device.entry("sparse.sharded.cold"):
+        out, sweeps, exh = fn(*dev_args)
+    _record_sweeps(sweeps)
+    return finish(np.asarray(out), np.asarray(exh))
 
 
 @lru_cache(maxsize=64)

@@ -60,11 +60,16 @@ from ..ops.score_fused import (
     pack_score_inputs,
     score_at_columns,
 )
+from ..ops.sparse2 import sparse_min2_reference, sparse_priced_min2
 
 __all__ = ["plan_next_map_tpu", "plan_pipeline", "solve_dense",
            "solve_dense_converged", "solve_converged_resilient",
            "solve_dense_warm", "SolveCarry", "carry_from_assignment",
-           "check_assignment", "maybe_validate"]
+           "check_assignment", "maybe_validate",
+           "solve_sparse", "solve_sparse_warm", "DenseScoreMemoryError",
+           "projected_score_bytes", "set_dense_score_budget",
+           "check_dense_memory", "sparse_rules_supported",
+           "resolve_sparse_impl"]
 
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
@@ -166,6 +171,82 @@ def resolve_default_fused_score(p: int, n: int) -> str:
     PlannerSession.replan, future callers) uses to turn the module
     default into a concrete jit-safe mode."""
     return resolve_fused_score(_FUSED_SCORE_DEFAULT, p, n)
+
+
+# --- dense-memory guard ------------------------------------------------------
+#
+# The matrix engine's score sweep materializes ~_MATRIX_BYTES_PER_CELL
+# bytes per [P, N] cell.  Past the accelerator budget XLA dies with an
+# opaque allocator error deep in compile (or the CPU backend swaps the
+# host to death); this guard turns that into a structured, actionable
+# error at solve ENTRY, naming the projected footprint and the ways out
+# (the sparse shortlist engine, the in-kernel fused engine, sharding).
+# None = derive from the device (the same 60%-of-HBM ceiling the engine
+# auto-selection uses); configurable for deployments with different
+# headroom — and for tests.
+
+_DENSE_GUARD_BUDGET: Optional[int] = None
+
+
+def set_dense_score_budget(n_bytes: Optional[int]) -> None:
+    """Override the dense-memory guard's byte budget (None = derive
+    from the device again)."""
+    global _DENSE_GUARD_BUDGET
+    if n_bytes is not None and int(n_bytes) <= 0:
+        raise ValueError(f"budget must be positive, got {n_bytes}")
+    _DENSE_GUARD_BUDGET = None if n_bytes is None else int(n_bytes)
+
+
+def dense_score_budget_bytes() -> int:
+    """The byte budget the dense-memory guard enforces."""
+    if _DENSE_GUARD_BUDGET is not None:
+        return _DENSE_GUARD_BUDGET
+    return int(_HBM_BUDGET_FRACTION * _device_hbm_bytes())
+
+
+def projected_score_bytes(p: int, n: int) -> int:
+    """Projected matrix-engine working set for a [P, N] problem (the
+    score sweep's live [P, N] f32 copies, calibrated on v5e — see
+    _MATRIX_BYTES_PER_CELL)."""
+    return int(p) * int(n) * _MATRIX_BYTES_PER_CELL
+
+
+class DenseScoreMemoryError(ValueError):
+    """The dense matrix engine's projected [P, N] score footprint
+    exceeds the memory budget.  Structured so callers can act on it:
+    ``projected_bytes`` / ``budget_bytes`` / ``shape`` (P, S, N)."""
+
+    def __init__(self, projected_bytes: int, budget_bytes: int,
+                 shape: tuple):
+        self.projected_bytes = int(projected_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.shape = tuple(shape)
+        p, s, n = shape
+        super().__init__(
+            f"dense score sweep would materialize ~"
+            f"{projected_bytes / 2**30:.1f} GiB of [P, N] intermediates "
+            f"(P={p}, S={s}, N={n}, ~{_MATRIX_BYTES_PER_CELL} B/cell) — "
+            f"over the {budget_bytes / 2**30:.1f} GiB budget; refusing "
+            f"before XLA OOMs opaquely.  Ways out: the sparse shortlist "
+            f"engine (PlanOptions(sparse=True) or plan.tensor."
+            f"solve_sparse, K candidates/partition instead of N), a "
+            f"smaller K if already sparse, the in-kernel fused engine "
+            f"on TPU (set_fused_score_default('on')), sharding the "
+            f"partition axis (parallel.sharded), or raising the budget "
+            f"(plan.tensor.set_dense_score_budget)")
+
+
+def check_dense_memory(p: int, s: int, n: int, engine: str) -> None:
+    """Raise :class:`DenseScoreMemoryError` when the MATRIX engine
+    (``engine == "off"``) is about to materialize a [P, N] score sweep
+    past the budget.  The fused/sparse engines never materialize it and
+    pass untouched."""
+    if engine != "off":
+        return
+    projected = projected_score_bytes(p, n)
+    budget = dense_score_budget_bytes()
+    if projected > budget:
+        raise DenseScoreMemoryError(projected, budget, (p, s, n))
 
 
 class SolveCarry(NamedTuple):
@@ -326,24 +407,29 @@ def _psum(x: jnp.ndarray, axis_name: Optional[str]) -> jnp.ndarray:
 
 def _hier_tier_at(
     anchors: jnp.ndarray,  # [P, A] global node ids, -1 absent
-    node: jnp.ndarray,  # [P] global node ids (>= 0 assumed meaningful)
+    node: jnp.ndarray,  # [P] or [P, K] global node ids
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
     rules: tuple,
 ) -> jnp.ndarray:
-    """_hier_penalty evaluated at ONE column per row — [P] ops only."""
-    p = node.shape[0]
+    """_hier_penalty evaluated at gathered columns — O(rows * cols) ops.
+
+    ``node`` may be [P] (one column per row: phase B's waterfall probe)
+    or [P, K] (the sparse shortlist's candidate block); the anchor axis
+    broadcasts against any trailing shape, and the [P] spelling is
+    bit-identical to what it always was."""
     any_anchor = jnp.any(anchors >= 0, axis=1)
+    sh = (node.shape[0],) + (1,) * (node.ndim - 1)
     nd = jnp.clip(node, 0, gids.shape[1] - 1)
-    pen = jnp.full(p, _RULE_MISS, jnp.float32)
+    pen = jnp.full(node.shape, _RULE_MISS, jnp.float32)
     for idx, (inc, exc) in enumerate(rules):
-        sat = jnp.ones(p, jnp.bool_)
+        sat = jnp.ones(node.shape, jnp.bool_)
         for ai in range(anchors.shape[1]):
             sat &= _anchor_rule_sat(
                 anchors[:, ai], gids[inc][nd], gids[exc][nd],
                 gids, gid_valid, inc, exc)
         pen = jnp.where(sat, jnp.minimum(pen, idx * _RULE_TIER), pen)
-    return jnp.where(any_anchor, pen, 0.0)
+    return jnp.where(any_anchor.reshape(sh), pen, 0.0)
 
 
 def _hier_floor_counts(
@@ -352,6 +438,9 @@ def _hier_floor_counts(
     gid_valid: jnp.ndarray,
     valid: jnp.ndarray,  # [N] full
     rules: tuple,
+    taken_stack: Optional[jnp.ndarray] = None,  # [P, T] GLOBAL node ids
+    # the row's partition already occupies; those columns are +INF in
+    # the score, so a taken-aware floor must not count them attainable
 ) -> jnp.ndarray:
     """Best attainable rule tier over valid nodes, by GROUP COUNTING.
 
@@ -413,6 +502,29 @@ def _hier_floor_counts(
 
         count = jnp.where(
             ok & (g >= 0), cnt_inc[jnp.clip(g, 0, n - 1)] - excl, 0.0)
+
+        # Taken-aware: subtract the row's own occupied nodes still
+        # standing in the include group but OUTSIDE every counted
+        # exclude group (those inside were subtracted with their group).
+        # Mirrors the audit's attainable_count (_count_hier_misses_fast)
+        # including its dedup of repeated ids, so the floor agrees with
+        # the matrix row-min over score columns the taken mask +INFs.
+        if taken_stack is not None:
+            t_seen = []
+            for ti in range(taken_stack.shape[1]):
+                u = taken_stack[:, ti]
+                uu = jnp.clip(u, 0, n - 1)
+                ok_u = (u >= 0) & valid[uu]
+                in_g = ok_u & (gids[inc][uu] == g) & (g >= 0)
+                in_excl = jnp.zeros(p, jnp.bool_)
+                for e in e_seen:
+                    in_excl |= (e >= 0) & (gids[exc][uu] == e)
+                dup = jnp.zeros(p, jnp.bool_)
+                for prev_u in t_seen:
+                    dup |= (u == prev_u) & (u >= 0)
+                count = count - jnp.where(in_g & ~in_excl & ~dup, 1.0, 0.0)
+                t_seen.append(u)
+
         floor = jnp.where(count > 0,
                           jnp.minimum(floor, idx * _RULE_TIER), floor)
     return jnp.where(any_anchor, floor, 0.0)
@@ -668,6 +780,59 @@ def _pin_prev_holders(
     return lax.cond(jnp.any(node_w > cap), trim, keep_all, None)
 
 
+def _sparse_score_cols(
+    cols: jnp.ndarray,  # [M, K] GLOBAL node ids; -1 = pad (scores +_INF)
+    rows: jnp.ndarray,  # [M] local row ids
+    pbase,  # global partition index of local row 0 (jitter)
+    *,
+    total: jnp.ndarray,  # [N] full fill vector
+    total_p: jnp.ndarray,
+    w_div: jnp.ndarray,  # [N]
+    neg_boost: jnp.ndarray,  # [N]
+    valid: jnp.ndarray,  # [N] bool
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    stick_si: jnp.ndarray,  # [P]
+    prev_slot: jnp.ndarray,  # [P] global ids
+    prev_state: jnp.ndarray,  # [P, R]
+    taken_ids: tuple,
+    anchors: Optional[jnp.ndarray],  # [P, A] (rules only)
+    rules: tuple,
+    jitter_scale: float,
+) -> jnp.ndarray:
+    """The MATRIX engine's score formula evaluated at gathered columns.
+
+    This is the sparse path's score: term order mirrors run_auction's
+    matrix build EXACTLY, so with a saturating shortlist (row r's
+    columns = 0..N-1) the [P, N] result is bitwise the dense matrix —
+    the foundation of the K = N bit-identity contract.  Pad columns
+    (id -1) score +_INF like any forbidden node.  O(M * K) ops and
+    HBM traffic; no [P, N] tensor exists."""
+    n = w_div.shape[0]
+    c = jnp.clip(cols, 0, n - 1)
+    okc = cols >= 0
+    st = stick_si[rows][:, None]
+    score = 0.001 * total[c] / jnp.maximum(total_p, 1.0)
+    score = score / w_div[c]
+    # Same-ordinal alignment (matrix: -0.01 * _member_ids(prev_slot)).
+    score = score - 0.01 * ((prev_slot[rows][:, None] == cols) & okc)
+    nb = neg_boost[c]
+    score = score + jnp.maximum(nb, jnp.where(nb > 0, st, 0.0))
+    sticky = jnp.zeros(cols.shape, jnp.bool_)
+    for r in range(prev_state.shape[1]):
+        sticky = sticky | ((prev_state[rows, r][:, None] == cols) & okc)
+    score = score - st * sticky
+    if rules:
+        score = score + _hier_tier_at(
+            anchors[rows], c, gids, gid_valid, rules)
+    taken = jnp.zeros(cols.shape, jnp.bool_)
+    for tid in taken_ids:
+        taken = taken | ((tid[rows][:, None] == cols) & okc)
+    score = score + _INF * (taken | ~valid[c] | ~okc)
+    pi = (pbase + rows)[:, None].astype(jnp.int32)
+    return score + jitter_scale * jitter_hash(pi, c.astype(jnp.int32))
+
+
 def _assign_slot(
     min2_fn,  # price_vec[N] -> (best, choice GLOBAL, second, raw-at-choice)
     score_at_fn,  # (rows[K], cols_global[K]) -> unpriced score values [K]
@@ -684,6 +849,11 @@ def _assign_slot(
     has_rules: bool = True,  # static: state carries hierarchy rules
     feasible_hint: Optional[jnp.ndarray] = None,  # [P] bool, required when
     # has_rules=False and topup_share is set: any allowed node exists
+    allow: Optional[jnp.ndarray] = None,  # [P] bool — rows the caller
+    # permits to take a slot here at all.  The sparse path gates rows
+    # whose shortlist cannot reach the globally attainable rule tier:
+    # they neither bid nor get forced, staying -1 for the per-row dense
+    # fallback instead of silently accepting a worse-tier placement.
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Auction: returns (slot_assign[P] int32 GLOBAL node id or -1, used[N]).
 
@@ -734,6 +904,8 @@ def _assign_slot(
     else:
         raw_best_all = None
         hard_feasible = feasible_hint
+    if allow is not None and hard_feasible is not None:
+        hard_feasible = hard_feasible & allow
 
     def round_body(carry):
         slot_assign, unassigned, rem_cap, used, _progress, it = carry
@@ -763,6 +935,8 @@ def _assign_slot(
         rule_ok = ((raw_choice < raw_best_all + _RULE_TIER * 0.5)
                    | (raw_best_all >= _RULE_MISS / 2)) if has_rules else True
         active = unassigned & (best < _INF / 2) & rule_ok
+        if allow is not None:
+            active = active & allow
 
         # Sort bidders by (node, urgency desc) via two stable argsorts —
         # avoids packing into int64, which is x64-gated.  Inactive bidders
@@ -910,6 +1084,8 @@ def _assign_slot(
             used_global * price_scale)
         feasible = best < _INF / 2
         forced = unassigned & feasible
+        if allow is not None:
+            forced = forced & allow
         slot_assign = jnp.where(forced, choice, slot_assign)
         used_forced = jnp.zeros(n, jnp.float32).at[choice].add(
             jnp.where(forced, pweights, 0.0))
@@ -926,10 +1102,7 @@ def _assign_slot(
     return slot_assign, used
 
 
-@partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
-                                   "node_axis", "node_shards",
-                                   "fused_score"))
-def solve_dense(
+def _solve_assign(
     prev: jnp.ndarray,  # [P, S, R] int32 (GLOBAL node ids)
     pweights: jnp.ndarray,  # [P] float32
     nweights: jnp.ndarray,  # [N] float32 (full, node-replicated)
@@ -957,8 +1130,24 @@ def solve_dense(
     # identical to the unpadded solve, so bucketing is bit-neutral.
     # Traced, not static: drifting real sizes inside one bucket must not
     # retrigger compilation.
-) -> jnp.ndarray:
-    """Solve the whole placement problem on device; returns assign[P, S, R].
+    shortlist: Optional[jnp.ndarray] = None,  # [P, K] GLOBAL candidate
+    # node ids per partition (-1 pads), ascending per row — the SPARSE
+    # engine.  Scores are evaluated only at these columns ([P, S, K]
+    # work per sweep) while fill/price/capacity stay full [S, N] width,
+    # so acceptance and the audit contracts run against real global
+    # state.  A saturating shortlist (row r = 0..N-1) is bit-identical
+    # to the dense engines.
+    sparse_impl: str = "xla",  # static: "xla" reference reduction,
+    # "pallas" = the fused ops/sparse2.py kernel, "interpret" = that
+    # kernel under the pallas interpreter (CPU tests)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One assignment sweep; returns (assign[P, S, R], exhausted[P]).
+
+    ``exhausted`` is all-False on the dense engines; on the sparse
+    engine it flags rows whose shortlist could not reach the globally
+    attainable rule tier (or had no feasible candidate) for some slot —
+    the rows the caller must re-place through the per-row dense
+    fallback.
 
     With ``node_axis`` set (a 2-D parts x nodes mesh), every [P, N]
     intermediate — score, penalties, stickiness/taken masks — holds only
@@ -974,6 +1163,22 @@ def solve_dense(
         # silent passthrough here would select the compiled kernel on
         # hosts that can't run it.
         raise ValueError(f"unresolved fused-score mode: {fused_score!r}")
+    if shortlist is not None:
+        if node_axis:
+            raise ValueError(
+                "sparse solve does not support node-axis sharding: the "
+                "[P, K] shortlist already bounds the column working set; "
+                "shard the partition axis instead")
+        if sparse_impl not in ("xla", "pallas", "interpret"):
+            raise ValueError(f"unknown sparse_impl: {sparse_impl!r}")
+        if not all(exc < inc for rl in rules for (inc, exc) in rl):
+            # The shortlist-exhaustion gate needs the group-counting
+            # attainability floor, which only exists for nesting rules
+            # (exclude strictly finer than include — the tree shape).
+            raise ValueError(
+                "sparse solve requires nesting hierarchy rules "
+                "(exclude_level < include_level for every rule); use the "
+                "dense engines for exotic rule shapes")
     if constraints and max(constraints) > r_max:
         # JAX drops out-of-bounds scatter writes silently; without this the
         # slots beyond R would vanish while still consuming capacity.
@@ -1034,6 +1239,10 @@ def solve_dense(
         total = _psum(total, axis_name)
 
     assign = jnp.full((p, s, r_max), -1, jnp.int32)
+    # Sparse-engine escape hatch: rows whose shortlist could not serve
+    # some slot (all-False on the dense engines, and on fully-pinned
+    # slots — a pinned copy proved its tier through the pin pass).
+    exhausted = jnp.zeros(p, jnp.bool_)
     # Nodes already holding this partition at an equal-or-higher priority
     # state in this pass (excludeHigherPriorityNodes, plan.go:146-156).
     # Kept as a LIST of [P] global-id columns, not a [P, N] bitmap: the
@@ -1217,7 +1426,53 @@ def solve_dense(
                 anchors_k = anchors if rules[si] else \
                     jnp.full((p, 1), -1, jnp.int32)
 
-                if fused_score != "off":
+                if shortlist is not None:
+                    # SPARSE engine: evaluate the matrix formula only at
+                    # the [P, K] shortlist columns; fill/price/capacity
+                    # stay full [S, N] width.  min2 reduces the gathered
+                    # block (fused kernel on TPU); phase B's waterfall
+                    # probes return +INF outside the row's shortlist, so
+                    # stragglers never leak past their candidate set.
+                    cand = shortlist
+                    cand_c = jnp.clip(cand, 0, n - 1)
+                    rows_p = jnp.arange(p)
+                    score_pk = _sparse_score_cols(
+                        cand, rows_p, pbase, total=total, total_p=total_p,
+                        w_div=w_div, neg_boost=neg_boost, valid=valid,
+                        gids=gids, gid_valid=gid_valid, stick_si=stick_si,
+                        prev_slot=prev_slot, prev_state=prev_state_ids,
+                        taken_ids=taken_ids, anchors=anchors_k,
+                        rules=rules[si], jitter_scale=float(_JITTER))
+
+                    def min2_fn(price_vec, *, score_pk=score_pk,
+                                cand=cand, cand_c=cand_c):
+                        price_pk = price_vec[cand_c]
+                        if sparse_impl == "xla":
+                            b, kidx, s2, raw = sparse_min2_reference(
+                                score_pk, price_pk)
+                        else:
+                            b, kidx, s2, raw = sparse_priced_min2(
+                                score_pk, price_pk,
+                                interpret=(sparse_impl == "interpret"))
+                        choice = jnp.maximum(jnp.take_along_axis(
+                            cand, kidx[:, None], axis=1)[:, 0], 0)
+                        return b, choice, s2, raw
+
+                    def score_at_fn(rows, cols_global, *, cand=cand):
+                        vals = _sparse_score_cols(
+                            cols_global[:, None], rows, pbase,
+                            total=total, total_p=total_p, w_div=w_div,
+                            neg_boost=neg_boost, valid=valid, gids=gids,
+                            gid_valid=gid_valid, stick_si=stick_si,
+                            prev_slot=prev_slot,
+                            prev_state=prev_state_ids,
+                            taken_ids=taken_ids, anchors=anchors_k,
+                            rules=rules[si],
+                            jitter_scale=float(_JITTER))[:, 0]
+                        in_sl = jnp.any(
+                            cand[rows] == cols_global[:, None], axis=1)
+                        return jnp.where(in_sl, vals, _INF)
+                elif fused_score != "off":
                     si_pack = pack_score_inputs(
                         total_l=total_l, total_p=total_p, w_div_l=w_div_l,
                         neg_boost_l=neg_boost_l, valid_l=valid_l,
@@ -1322,25 +1577,57 @@ def solve_dense(
                                 ).astype(jnp.int32)
                     feasible_hint = tkn < n_valid_total
 
+                allow = None
+                exh_slot = jnp.zeros(p, jnp.bool_)
+                if shortlist is not None:
+                    # Shortlist adequacy, judged against GLOBAL state: a
+                    # row may take this slot only when its shortlist
+                    # best reaches the globally attainable rule tier
+                    # (group-counting floor, taken-aware — [P] ops, no
+                    # [P, N] row-min) or, rule-less, offers any feasible
+                    # candidate while one exists anywhere.  Inadequate
+                    # rows sit out the whole slot (no bid, no force) and
+                    # are flagged for the per-row dense fallback; at a
+                    # saturating K the shortlist best IS the global
+                    # best, so the gate passes exactly when the dense
+                    # engines would have placed the row.
+                    raw_best_sl = jnp.min(score_pk, axis=1)
+                    if rules[si]:
+                        floor_sl = _hier_floor_counts(
+                            anchors, gids, gid_valid, valid, rules[si],
+                            taken_stack=(jnp.stack(taken_ids, axis=1)
+                                         if taken_ids else None))
+                        allow = raw_best_sl < floor_sl + _RULE_TIER * 0.5
+                    else:
+                        sl_feas = raw_best_sl < _INF / 2
+                        allow = sl_feas | ~feasible_hint
+                        # Top-up must weigh shortlist-feasible rows, not
+                        # globally-feasible ones the gate excluded.
+                        feasible_hint = sl_feas
+                    exh_slot = (init_assign < 0) & ~allow
+
                 # Exact ceil capacity: the binding rail that yields tight
                 # balance; exclusivity stragglers rebid under the in-slot
                 # price and, in the worst case, the force step places them.
                 cap = _shard_capacity(
                     jnp.ceil(total_w * cap_share), axis_name)
-                return _assign_slot(
+                slot_assign, used = _assign_slot(
                     min2_fn, score_at_fn, p, pweights, cap, 1.0 / w_div,
                     axis_name, init_assign=init_assign, init_used=pin_used,
                     node_axis=node_axis, topup_share=cap_share,
-                    has_rules=bool(rules[si]), feasible_hint=feasible_hint)
+                    has_rules=bool(rules[si]), feasible_hint=feasible_hint,
+                    allow=allow)
+                return slot_assign, used, exh_slot
 
             def keep_pins(_):
-                return init_assign, pin_used
+                return init_assign, pin_used, jnp.zeros(p, jnp.bool_)
 
             # NB: no collectives run inside either branch (_assign_slot is
             # shard-local by design), so a cond on the globally-agreed
             # all_pinned flag is safe under shard_map.
-            slot_assign, used = lax.cond(
+            slot_assign, used, exh_slot = lax.cond(
                 all_pinned, keep_pins, run_auction, None)
+            exhausted = exhausted | exh_slot
             used = _psum(used, axis_name)  # global per-node accepted weight
 
             assign = assign.at[:, si, ri].set(slot_assign)
@@ -1352,7 +1639,40 @@ def solve_dense(
             if rules[si]:
                 anchors = anchors.at[:, 1 + ri].set(slot_assign)
 
-    return assign
+    return assign, exhausted
+
+
+_SOLVE_STATICS = ("constraints", "rules", "axis_name", "node_axis",
+                  "node_shards", "fused_score")
+
+
+@partial(jax.jit, static_argnames=_SOLVE_STATICS)
+def solve_dense(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    node_axis: Optional[str] = None,
+    node_shards: int = 1,
+    fused_score: str = "off",
+    carry_used: Optional[jnp.ndarray] = None,
+    p_real: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Solve the whole placement problem on device; returns assign[P, S, R].
+
+    The jitted dense spelling of :func:`_solve_assign` (see its
+    docstring for the full parameter/sharding contract); the sparse
+    engine enters through :func:`solve_sparse` instead."""
+    return _solve_assign(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        constraints, rules, axis_name, node_axis, node_shards,
+        fused_score, carry_used=carry_used, p_real=p_real)[0]
 
 
 @partial(jax.jit, static_argnames=("constraints", "rules", "axis_name",
@@ -1699,7 +2019,17 @@ def _warm_repair(
                       node_shards, fused_score, carry_used=carry_used,
                       p_real=p_real)
     new_used = _used_by_state(out, pweights, n, s, axis_name)
+    ok = _repair_ok(prev, out, new_used, carry_used, dirty, pweights,
+                    nweights, valid, constraints, axis_name)
+    return out, new_used, ok
 
+
+def _repair_ok(prev, out, new_used, carry_used, dirty, pweights, nweights,
+               valid, constraints, axis_name):
+    """The warm repair's acceptance gates (ripple + fresh over-capacity;
+    see :func:`_warm_repair`'s docstring) — extracted so the sparse
+    repair judges itself with the identical device-side checks."""
+    p = prev.shape[0]
     rippled = jnp.any((out != prev) & ~dirty[:, None, None])
     if axis_name:
         rippled = lax.psum(rippled.astype(jnp.int32), axis_name) > 0
@@ -1720,8 +2050,7 @@ def _warm_repair(
         rail = jnp.ceil(k * total_w * cap_share)
         overcap |= jnp.any((new_used[si] > rail + allowance)
                            & (new_used[si] > carry_used[si]))
-    ok = ~rippled & ~overcap
-    return out, new_used, ok
+    return ~rippled & ~overcap
 
 
 _WARM_STATICS = ("constraints", "rules", "axis_name", "node_axis",
@@ -1767,6 +2096,8 @@ def solve_dense_warm(
     rec = get_recorder()
     _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
                            constraints, rules)
+    check_dense_memory(np.asarray(prev).shape[0], np.asarray(prev).shape[1],
+                       np.asarray(nweights).shape[-1], fused_score)
     dirty_np = np.asarray(dirty)
     if record:
         rec.observe("plan.solve.dirty_fraction",
@@ -1804,6 +2135,456 @@ def solve_dense_warm(
         _record_sweeps(1)
         rec.set_attr("warm", True)
     return np.asarray(out), SolveCarry(
+        prices=jnp.sum(new_used, axis=0), assign=out, used=new_used)
+
+
+# --- sparse shortlist solve --------------------------------------------------
+#
+# ROADMAP item 2: the dense score sweep is f32 [P, N] per slot — 1M
+# partitions x 10k nodes is a ~40 GB intermediate no fusing fixes.  The
+# sparse engine scores only a [P, K] candidate shortlist (K << N,
+# derived statically in core/shortlist.py from stickiness + hierarchy
+# groups + weights) while the fill/price/capacity tables stay full
+# [S, N] width, so acceptance, tie-breaks and the audit contracts are
+# evaluated against real global state.  Rows whose shortlist cannot
+# reach the globally attainable rule tier (or has no feasible candidate)
+# are flagged in-graph and re-placed by a per-row dense fallback on the
+# host — the observable escape hatch (plan.sparse.* counters) that makes
+# audit contracts hold for ANY shortlist.  A saturating K = N shortlist
+# is bit-identical to the dense matrix engine, cold and warm.
+
+
+def sparse_rules_supported(rules: tuple) -> bool:
+    """True when the sparse engine can solve these rules (every
+    exclude level strictly finer than its include level — the nesting
+    tree shape the group-counting attainability floor requires)."""
+    from ..core.shortlist import shortlist_rules_nest
+
+    return shortlist_rules_nest(rules)
+
+
+def resolve_sparse_impl(impl: Optional[str]) -> str:
+    """None -> the fused ops/sparse2.py kernel on TPU, the XLA
+    reference elsewhere; explicit modes pass through validated."""
+    if impl is None:
+        return "pallas" if pallas_available() else "xla"
+    if impl not in ("xla", "pallas", "interpret"):
+        raise ValueError(f"unknown sparse_impl: {impl!r}")
+    return impl
+
+
+_SPARSE_STATICS = ("constraints", "rules", "axis_name", "max_iterations",
+                   "sparse_impl")
+
+
+@partial(jax.jit, static_argnames=_SPARSE_STATICS)
+def _solve_sparse_converged_impl(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    shortlist: jnp.ndarray,  # [P, K] ascending candidate ids, -1 pads
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    max_iterations: int = 10,
+    sparse_impl: str = "xla",
+    carry_used: Optional[jnp.ndarray] = None,
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, ...]:
+    """Jitted sparse fixpoint; returns (assign, sweeps, exhausted[P]).
+
+    The same converged loop as ``_solve_dense_converged_impl`` (carry
+    seeds the FIRST sweep only), over the shortlist engine.  The
+    exhaustion flags are the LAST executed sweep's — rows still
+    unservable at the fixpoint, which the host fallback re-places."""
+    def solve(x, cu=None):
+        return _solve_assign(
+            x, pweights, nweights, valid, stickiness, gids, gid_valid,
+            constraints, rules, axis_name, None, 1, "off",
+            carry_used=cu, p_real=p_real, shortlist=shortlist,
+            sparse_impl=sparse_impl)
+
+    first, exh0 = solve(prev, carry_used)
+
+    def cond(carry):
+        out, prev_i, it, _exh = carry
+        changed = jnp.any(out != prev_i)
+        if axis_name:
+            changed = lax.psum(changed.astype(jnp.int32), axis_name) > 0
+        return changed & (it < max_iterations)
+
+    def body(carry):
+        out, _prev, it, _exh = carry
+        new, exh = solve(out)
+        return new, out, it + 1, exh
+
+    out, _, it, exh = lax.while_loop(
+        cond, body, (first, prev, jnp.array(1), exh0))
+    return out, it, exh
+
+
+def _warm_repair_sparse(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    shortlist: jnp.ndarray,
+    dirty: jnp.ndarray,
+    carry_used: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    sparse_impl: str = "xla",
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, ...]:
+    """ONE carry-seeded sparse repair sweep; returns
+    (assign, new_used[S, N], ok, exhausted[P]) with the exact
+    acceptance gates of :func:`_warm_repair` (shared ``_repair_ok``),
+    so ``PlannerSession``/``CarryCache`` semantics carry over
+    unchanged.  Exhausted rows come back -1 and, being changed rows,
+    are only acceptable when the dirty mask covers them — the caller
+    then routes them through the per-row dense fallback."""
+    p, s, _ = prev.shape
+    n = nweights.shape[0]
+    out, exh = _solve_assign(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        constraints, rules, axis_name, None, 1, "off",
+        carry_used=carry_used, p_real=p_real, shortlist=shortlist,
+        sparse_impl=sparse_impl)
+    new_used = _used_by_state(out, pweights, n, s, axis_name)
+    ok = _repair_ok(prev, out, new_used, carry_used, dirty, pweights,
+                    nweights, valid, constraints, axis_name)
+    return out, new_used, ok, exh
+
+
+_WARM_SPARSE_STATICS = ("constraints", "rules", "axis_name", "sparse_impl")
+_warm_repair_sparse_jit = partial(
+    jax.jit, static_argnames=_WARM_SPARSE_STATICS)(_warm_repair_sparse)
+# Same donation contract as _warm_repair_donating: the carry is
+# single-use and prev aliases into the same-shaped assign output.
+_warm_repair_sparse_donating = jax.jit(
+    _warm_repair_sparse, static_argnames=_WARM_SPARSE_STATICS,
+    donate_argnames=("prev", "carry_used"))
+
+
+def _sparse_fallback_rows(
+    assign: np.ndarray,  # [P, S, R] the sparse result (NOT mutated)
+    rows: np.ndarray,  # indices of exhausted rows
+    prev: np.ndarray,
+    pweights: np.ndarray,
+    nweights: np.ndarray,
+    valid: np.ndarray,
+    stickiness: np.ndarray,
+    gids: np.ndarray,
+    gid_valid: np.ndarray,
+    constraints: tuple,
+    rules: tuple,
+) -> np.ndarray:
+    """Per-row DENSE fallback for shortlist-exhausted partitions.
+
+    Discards the flagged rows' sparse placements entirely and re-places
+    every slot in order against the full node axis — anchors, taken-set
+    and rule tiers evaluated exactly as the audit judges them, priced by
+    the real global fill so the handful of fallback rows spread instead
+    of herding.  Host numpy over a [B, N] block (B = exhausted rows,
+    rare by design): the whole point of the flag is that only these
+    rows ever pay dense cost.  Returns a patched copy."""
+    assign = np.array(np.asarray(assign), copy=True)
+    rows = np.asarray(rows)
+    P, S, R = assign.shape
+    nw = np.asarray(nweights, np.float32)
+    n = nw.shape[0]
+    if rows.size == 0 or n == 0:
+        return assign
+    pw = np.asarray(pweights, np.float32)
+    valid = np.asarray(valid, bool)
+    gids = np.asarray(gids)
+    gid_valid = np.asarray(gid_valid)
+    w_div = np.where(nw > 0, nw, 1.0)
+    neg_boost = np.maximum(-nw, 0.0)
+
+    kept = assign.copy()
+    kept[rows] = -1
+    used_s = np.zeros((S, n), np.float32)
+    for si in range(S):
+        ids = kept[:, si, :]
+        m = ids >= 0
+        if m.any():
+            w_rep = np.broadcast_to(pw[:, None], ids.shape)
+            used_s[si] = np.bincount(
+                ids[m].ravel(), weights=w_rep[m].ravel(),
+                minlength=n)[:n].astype(np.float32)
+    total = used_s.sum(axis=0)
+
+    B = rows.size
+    prev_b = np.asarray(prev)[rows]
+    stick_b = np.asarray(stickiness, np.float32)[rows]
+    pw_b = pw[rows]
+    top_anchor = prev_b[:, 0, 0]
+    new_rows = np.full((B, S, R), -1, np.int32)
+    taken: list[np.ndarray] = []
+    ar = np.arange(B)
+    for si in range(S):
+        kcon = int(constraints[si])
+        if kcon <= 0:
+            continue
+        rules_si = list(rules[si]) if si < len(rules) else []
+        if rules_si:
+            base = top_anchor if si == 0 else np.where(
+                new_rows[:, 0, 0] >= 0, new_rows[:, 0, 0], top_anchor)
+            anchors = [base]
+        for ri in range(min(kcon, R)):
+            score = (0.001 * total[None, :] / max(float(P), 1.0)) \
+                / w_div[None, :]
+            prev_slot = prev_b[:, si, ri] if ri < prev_b.shape[2] \
+                else np.full(B, -1, np.int32)
+            align = np.zeros((B, n), bool)
+            hold = prev_slot >= 0
+            align[ar[hold], prev_slot[hold]] = True
+            score = score - 0.01 * align
+            score = score + np.maximum(
+                neg_boost[None, :],
+                np.where(neg_boost[None, :] > 0,
+                         stick_b[:, si][:, None], 0.0))
+            sticky = np.zeros((B, n), bool)
+            for r in range(prev_b.shape[2]):
+                ps = prev_b[:, si, r]
+                hold = ps >= 0
+                sticky[ar[hold], ps[hold]] = True
+            score = score - stick_b[:, si][:, None] * sticky
+            if rules_si:
+                pen = np.full((B, n), _RULE_MISS, np.float32)
+                for idx, (inc, exc) in enumerate(rules_si):
+                    sat = np.ones((B, n), bool)
+                    for a in anchors:
+                        aa = np.clip(a, 0, n - 1)
+                        inc_same = (gids[inc][aa][:, None]
+                                    == gids[inc][None, :]) \
+                            & gid_valid[inc][aa][:, None]
+                        exc_same = (gids[exc][aa][:, None]
+                                    == gids[exc][None, :]) \
+                            & gid_valid[exc][aa][:, None]
+                        sat &= np.where((a >= 0)[:, None],
+                                        inc_same & ~exc_same, True)
+                    pen = np.where(sat, np.minimum(pen, idx * _RULE_TIER),
+                                   pen)
+                any_anchor = np.zeros(B, bool)
+                for a in anchors:
+                    any_anchor |= a >= 0
+                score = score + np.where(any_anchor[:, None], pen, 0.0)
+            tk = np.zeros((B, n), bool)
+            for t in taken:
+                held = t >= 0
+                tk[ar[held], t[held]] = True
+            score = score + _INF * (tk | ~valid[None, :])
+            # Price by the state's live global fill so concurrent
+            # fallback rows spread (the force step's pricing idiom).
+            score = score + used_s[si][None, :] / w_div[None, :]
+            choice = np.argmin(score, axis=1).astype(np.int32)
+            feas = score[ar, choice] < _INF / 2
+            pick = np.where(feas, choice, -1).astype(np.int32)
+            new_rows[:, si, ri] = pick
+            placed = pick[feas]
+            np.add.at(used_s[si], placed, pw_b[feas])
+            np.add.at(total, placed, pw_b[feas])
+            taken.append(pick)
+            if rules_si:
+                anchors.append(pick)
+    assign[rows] = new_rows
+    return assign
+
+
+def _apply_sparse_fallback(
+    assign: np.ndarray, exhausted: np.ndarray, prev, pweights, nweights,
+    valid, stickiness, gids, gid_valid, constraints, rules,
+    record: bool = True,
+) -> tuple[np.ndarray, int]:
+    """Route flagged rows through the dense fallback; returns
+    (patched assign, rows re-placed).  Publishes the
+    ``plan.sparse.shortlist_exhausted`` / ``dense_fallback_rows``
+    counters so the escape hatch is observable."""
+    rows = np.nonzero(np.asarray(exhausted))[0]
+    if rows.size == 0:
+        return np.asarray(assign), 0
+    rec = get_recorder()
+    if record:
+        rec.count("plan.sparse.shortlist_exhausted", int(rows.size))
+    patched = _sparse_fallback_rows(
+        assign, rows, np.asarray(prev), pweights, nweights, valid,
+        stickiness, gids, gid_valid, constraints, rules)
+    replaced = int(np.any(
+        patched[rows] != np.asarray(assign)[rows], axis=(1, 2)).sum())
+    if record and replaced:
+        rec.count("plan.sparse.dense_fallback_rows", replaced)
+    return patched, replaced
+
+
+def _build_or_adopt_shortlist(
+    prev, pweights, nweights, valid, gids, gid_valid, constraints, rules,
+    shortlist, k, record: bool,
+):
+    """The host entries' shared shortlist step: adopt a caller-built
+    [P, K] table or derive one (timed as plan.sparse.shortlist_build_s),
+    and publish the k_effective gauge."""
+    from ..core.shortlist import auto_shortlist_k, build_shortlist
+
+    rec = get_recorder()
+    if shortlist is None:
+        n = np.asarray(nweights).shape[-1]
+        kk = int(k) if k is not None \
+            else auto_shortlist_k(n, constraints, rules)
+        t0 = rec.now()
+        shortlist = build_shortlist(
+            prev, pweights, nweights, valid, gids, gid_valid,
+            constraints, rules, kk)
+        if record:
+            rec.observe("plan.sparse.shortlist_build_s", rec.now() - t0)
+    shortlist = jnp.asarray(shortlist)
+    if record:
+        rec.set_gauge("plan.sparse.k_effective",
+                      float(shortlist.shape[1] if shortlist.ndim == 2
+                            else 0))
+    return shortlist
+
+
+def solve_sparse(
+    prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+    constraints, rules, *, shortlist=None, k: Optional[int] = None,
+    max_iterations: int = 10, record: bool = True, carry_used=None,
+    return_carry: bool = False, p_real=None,
+    sparse_impl: Optional[str] = None,
+):
+    """Sparse converged solve: shortlist -> [P, S, K] auction ->
+    per-row dense fallback for exhausted rows.  The sparse sibling of
+    :func:`solve_dense_converged` — same positional contract, returns
+    the assignment as numpy (plus the rebuilt :class:`SolveCarry` with
+    ``return_carry``).
+
+    ``shortlist`` adopts a caller-built [P, K] table; otherwise one is
+    derived (``k`` columns, auto-sized when None — see
+    core/shortlist.py).  A saturating K >= N is bit-identical to the
+    dense matrix engine (map, warnings and moves), the pinned contract
+    that keeps the two paths from drifting.  ``carry_used`` seeds the
+    first sweep exactly like the dense loop, so warm sessions ride it
+    unchanged.
+    """
+    constraints = tuple(int(c) for c in constraints)
+    rules = tuple(tuple(r) for r in rules)
+    if not sparse_rules_supported(rules):
+        raise ValueError(
+            "sparse solve requires nesting hierarchy rules "
+            "(exclude_level < include_level); use the dense engines")
+    _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
+                           constraints, rules)
+    impl = resolve_sparse_impl(sparse_impl)
+    rec = get_recorder()
+    ent = _device.ambient_entry() or (
+        "sparse.carry" if carry_used is not None else "sparse.cold")
+    # The entry scope opens before the shortlist step: the cold entry
+    # owns TWO programs (the jitted builder + the converged fixpoint),
+    # and the retrace budget (analysis/retrace.py) is sized for both —
+    # a builder retrace must land in THIS bucket, not "other".
+    with _device.entry(ent):
+        shortlist = _build_or_adopt_shortlist(
+            prev, pweights, nweights, valid, gids, gid_valid,
+            constraints, rules, shortlist, k, record)
+        with rec.span("plan.solve.attempt", engine="sparse"):
+            out, sweeps, exh = _solve_sparse_converged_impl(
+                jnp.asarray(prev), jnp.asarray(pweights),
+                jnp.asarray(nweights), jnp.asarray(valid),
+                jnp.asarray(stickiness), jnp.asarray(gids),
+                jnp.asarray(gid_valid), shortlist,
+                constraints=constraints, rules=rules,
+                max_iterations=max(int(max_iterations), 1),
+                sparse_impl=impl, carry_used=carry_used, p_real=p_real)
+            out_np = np.asarray(out)
+            exh_np = np.asarray(exh)
+    if record:
+        _record_sweeps(sweeps)
+    out_np, _replaced = _apply_sparse_fallback(
+        out_np, exh_np, prev, pweights, nweights, valid, stickiness,
+        gids, gid_valid, constraints, rules, record=record)
+    if return_carry:
+        return out_np, carry_from_assignment(
+            jnp.asarray(out_np), jnp.asarray(pweights),
+            jnp.asarray(nweights))
+    return out_np
+
+
+def solve_sparse_warm(
+    prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+    constraints, rules, *, dirty, carry: SolveCarry, shortlist=None,
+    k: Optional[int] = None, record: bool = True,
+    donate: Optional[bool] = None, p_real=None,
+    sparse_impl: Optional[str] = None,
+) -> tuple[Optional[np.ndarray], Optional[SolveCarry]]:
+    """Warm delta replan on the sparse engine: one carry-seeded repair
+    sweep over the shortlist, or decline — the exact
+    :func:`solve_dense_warm` contract ((None, None) on decline, carry
+    consumed either way, same obs counters), so sessions and the
+    CarryCache ride the sparse path unchanged.  Exhausted rows in an
+    ACCEPTED repair go through the per-row dense fallback and the
+    returned carry is rebuilt from the patched assignment."""
+    constraints = tuple(int(c) for c in constraints)
+    rules = tuple(tuple(r) for r in rules)
+    if not sparse_rules_supported(rules):
+        raise ValueError(
+            "sparse solve requires nesting hierarchy rules "
+            "(exclude_level < include_level); use the dense engines")
+    rec = get_recorder()
+    _check_tier_band_scale(prev, pweights, nweights, valid, stickiness,
+                           constraints, rules)
+    impl = resolve_sparse_impl(sparse_impl)
+    dirty_np = np.asarray(dirty)
+    if record:
+        rec.observe("plan.solve.dirty_fraction",
+                    float(dirty_np.mean()) if dirty_np.size else 0.0)
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+    impl_fn = _warm_repair_sparse_donating if donate \
+        else _warm_repair_sparse_jit
+    # The donating dispatch consumes prev's device buffer (aliased into
+    # the repair output), but the exhaustion fallback below still needs
+    # the pre-repair placement — snapshot it host-side first.
+    prev_fb = np.asarray(prev) if donate else prev
+    with _device.entry("sparse.warm"):
+        shortlist = _build_or_adopt_shortlist(
+            prev, pweights, nweights, valid, gids, gid_valid,
+            constraints, rules, shortlist, k, record)
+        with rec.span("plan.solve.attempt", warm=True, engine="sparse"):
+            out, new_used, ok, exh = impl_fn(
+                jnp.asarray(prev), jnp.asarray(pweights),
+                jnp.asarray(nweights), jnp.asarray(valid),
+                jnp.asarray(stickiness), jnp.asarray(gids),
+                jnp.asarray(gid_valid), shortlist,
+                jnp.asarray(dirty_np), jnp.asarray(carry.used),
+                constraints=constraints, rules=rules, sparse_impl=impl,
+                p_real=p_real)
+            accepted = bool(ok)
+    if not accepted:
+        if record:
+            rec.count("plan.solve.warm_fallback")
+            rec.count("plan.solve.sweeps", 1)  # the executed repair pass
+        return None, None
+    if record:
+        _record_sweeps(1)
+        rec.set_attr("warm", True)
+    out_np = np.asarray(out)
+    patched, replaced = _apply_sparse_fallback(
+        out_np, np.asarray(exh), prev_fb, pweights, nweights, valid,
+        stickiness, gids, gid_valid, constraints, rules, record=record)
+    if replaced:
+        return patched, carry_from_assignment(
+            jnp.asarray(patched), jnp.asarray(pweights),
+            jnp.asarray(nweights))
+    return patched, SolveCarry(
         prices=jnp.sum(new_used, axis=0), assign=out, used=new_used)
 
 
@@ -1909,9 +2690,58 @@ def _pipeline_warm_impl(
             packed, counts)
 
 
+def _pipeline_sparse_cold_impl(
+    prev: jnp.ndarray,
+    pweights: jnp.ndarray,
+    nweights: jnp.ndarray,
+    valid: jnp.ndarray,
+    stickiness: jnp.ndarray,
+    gids: jnp.ndarray,
+    gid_valid: jnp.ndarray,
+    constraints: tuple,
+    rules: tuple,
+    axis_name: Optional[str] = None,
+    max_iterations: int = 10,
+    shortlist_k: int = 16,
+    sparse_impl: str = "xla",
+    favor_min_nodes: bool = False,
+    carry_used: Optional[jnp.ndarray] = None,
+    p_real: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, ...]:
+    """Sparse pipeline body: shortlist build -> sparse converged solve
+    -> diff(prev, out) -> pack, ONE traced program — the sparse variant
+    of :func:`_pipeline_cold_impl` (donation preserved: prev aliases
+    into assign/packed).  Returns the cold pipeline tuple plus the
+    exhaustion flags; the dispatcher re-places flagged rows host-side
+    and re-derives diff/pack for them (rare by design)."""
+    from ..core.encode import pack_assignment_core
+    from ..core.shortlist import build_shortlist_core
+    from ..moves.batch import diff_assignments
+
+    shortlist = build_shortlist_core(
+        prev, pweights, nweights, valid, gids, gid_valid, constraints,
+        rules, shortlist_k)
+    out, sweeps, exh = _solve_sparse_converged_impl(
+        prev, pweights, nweights, valid, stickiness, gids, gid_valid,
+        shortlist, constraints=constraints, rules=rules,
+        axis_name=axis_name, max_iterations=max_iterations,
+        sparse_impl=sparse_impl, carry_used=carry_used, p_real=p_real)
+    used = _used_by_state(out, pweights, nweights.shape[0], prev.shape[1],
+                          axis_name)
+    prices = jnp.sum(used, axis=0)
+    d_nodes, d_states, d_ops = diff_assignments(
+        prev, out, favor_min_nodes=favor_min_nodes)
+    packed, counts = pack_assignment_core(out)
+    return (out, sweeps, prices, used, d_nodes, d_states, d_ops,
+            packed, counts, exh)
+
+
 _PIPE_COLD_STATICS = ("constraints", "rules", "axis_name",
                       "max_iterations", "node_axis", "node_shards",
                       "fused_score", "favor_min_nodes")
+_PIPE_SPARSE_STATICS = ("constraints", "rules", "axis_name",
+                        "max_iterations", "shortlist_k", "sparse_impl",
+                        "favor_min_nodes")
 _PIPE_WARM_STATICS = ("constraints", "rules", "axis_name", "node_axis",
                       "node_shards", "fused_score", "favor_min_nodes")
 
@@ -1931,6 +2761,12 @@ _pipeline_warm_jit = partial(
 _pipeline_warm_donating = jax.jit(
     _pipeline_warm_impl, static_argnames=_PIPE_WARM_STATICS,
     donate_argnames=("prev", "carry_used"))
+_pipeline_sparse_jit = partial(
+    jax.jit, static_argnames=_PIPE_SPARSE_STATICS)(
+    _pipeline_sparse_cold_impl)
+_pipeline_sparse_donating = jax.jit(
+    _pipeline_sparse_cold_impl, static_argnames=_PIPE_SPARSE_STATICS,
+    donate_argnames=("prev",))
 
 
 def _seeded_beg_map(prev_map: PartitionMap,
@@ -2039,20 +2875,37 @@ def plan_pipeline(
         _check_tier_band_scale(prev_a, pw_a, nw_a, valid_a, stick_a,
                                constraints, rules)
         mode = resolve_default_fused_score(solve_p, solve_n)
+        use_sparse = _sparse_selected(opts, solve_p, problem.S, solve_n,
+                                      rules)
+        if not use_sparse:
+            check_dense_memory(solve_p, problem.S, solve_n, mode)
 
         try:
-            res = _dispatch_pipeline_cold(
-                prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
-                constraints, rules,
-                max_iterations=max(int(opts.max_iterations), 1),
-                fused_score=mode,
-                allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
-                favor_min_nodes=favor_min_nodes,
-                entry=("solve_dense.bucketed" if opts.shape_bucketing
-                       else "pipeline.cold"),
-                timer=timer,
-                p_real=(jax.device_put(np.float32(problem.P))
-                        if opts.shape_bucketing else None))
+            if use_sparse:
+                res = _dispatch_pipeline_sparse(
+                    prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
+                    constraints, rules,
+                    max_iterations=max(int(opts.max_iterations), 1),
+                    shortlist_k=_opts_shortlist_k(
+                        opts, solve_n, constraints, rules),
+                    sparse_impl=resolve_sparse_impl(None),
+                    favor_min_nodes=favor_min_nodes,
+                    entry="sparse.pipeline", timer=timer,
+                    p_real=(jax.device_put(np.float32(problem.P))
+                            if opts.shape_bucketing else None))
+            else:
+                res = _dispatch_pipeline_cold(
+                    prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
+                    constraints, rules,
+                    max_iterations=max(int(opts.max_iterations), 1),
+                    fused_score=mode,
+                    allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
+                    favor_min_nodes=favor_min_nodes,
+                    entry=("solve_dense.bucketed" if opts.shape_bucketing
+                           else "pipeline.cold"),
+                    timer=timer,
+                    p_real=(jax.device_put(np.float32(problem.P))
+                            if opts.shape_bucketing else None))
         except (ValueError, TypeError):
             raise  # deterministic input errors: same on the staged path
         except Exception as e:
@@ -2155,6 +3008,106 @@ def _dispatch_pipeline_cold(
         return run(alt)
 
 
+def _sparse_selected(opts: PlanOptions, p: int, s: int, n: int,
+                     rules: tuple) -> bool:
+    """Route a plan through the sparse shortlist engine?
+
+    ``opts.sparse`` True/False forces it (True + non-nesting rules is
+    an error); None = auto — sparse exactly when the dense matrix
+    engine's projected [P, N] footprint exceeds the memory budget and
+    the rules nest, i.e. when dense would be refused (CPU hosts) or
+    forced into the fused engine's O(P*N) compute (TPU)."""
+    sel = getattr(opts, "sparse", None)
+    if sel is False:
+        return False
+    nest = sparse_rules_supported(rules)
+    if sel:
+        if not nest:
+            raise ValueError(
+                "PlanOptions(sparse=True) requires nesting hierarchy "
+                "rules (exclude_level < include_level for every rule)")
+        return True
+    return nest and projected_score_bytes(p, n) > \
+        dense_score_budget_bytes()
+
+
+def _opts_shortlist_k(opts: PlanOptions, n: int, constraints: tuple,
+                      rules: tuple) -> int:
+    """PlanOptions.sparse_k, or the auto-derived K."""
+    from ..core.shortlist import auto_shortlist_k
+
+    k = getattr(opts, "sparse_k", None)
+    if k is not None:
+        if int(k) < 1:
+            raise ValueError(f"PlanOptions.sparse_k must be >= 1, got {k}")
+        return min(int(k), max(n, 1))
+    return auto_shortlist_k(n, constraints, rules)
+
+
+def _dispatch_pipeline_sparse(
+    prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
+    constraints: tuple, rules: tuple, *, max_iterations: int,
+    shortlist_k: int, sparse_impl: str, favor_min_nodes: bool,
+    entry: str, timer=None, carry_used=None, p_real=None, donate=True,
+):
+    """One sparse pipeline dispatch (shortlist -> sparse solve -> diff
+    -> pack in one program).  Returns the `_dispatch_pipeline_cold`
+    tuple; exhausted rows are re-placed by the host fallback and their
+    diff/pack re-derived in one small extra dispatch (the rare path)."""
+    rec = get_recorder()
+    impl = _pipeline_sparse_donating if donate else _pipeline_sparse_jit
+    # The donating dispatch aliases prev's buffer into the outputs, but
+    # the exhaustion fallback and its re-diff below still need the
+    # pre-solve placement — snapshot it host-side first (zero-copy for
+    # the numpy arrays the plan/session paths pass).
+    prev_fb = np.asarray(prev_a) if donate else prev_a
+    t0 = rec.now()
+    with phase_span("plan.pipeline.dispatch", timer=timer,
+                    engine="sparse"), \
+            _device.entry(entry):
+        (assign, sweeps, prices, used, d_nodes, d_states, d_ops,
+         packed, counts, exh) = impl(
+            jnp.asarray(prev_a), jnp.asarray(pw_a), jnp.asarray(nw_a),
+            jnp.asarray(valid_a), jnp.asarray(stick_a),
+            jnp.asarray(gids_a), jnp.asarray(gv_a),
+            constraints, rules, max_iterations=max_iterations,
+            shortlist_k=shortlist_k, sparse_impl=sparse_impl,
+            favor_min_nodes=favor_min_nodes, carry_used=carry_used,
+            p_real=p_real)
+        # One boundary crossing for the whole pipeline (plus the
+        # exhaustion flags, which gate the host escape hatch).
+        assign_np = np.asarray(assign)
+        exh_np = np.asarray(exh)
+    rec.observe("plan.pipeline.dispatch_s", rec.now() - t0)
+    rec.set_gauge("plan.sparse.k_effective", float(shortlist_k))
+    _record_sweeps(sweeps)
+    patched, replaced = _apply_sparse_fallback(
+        assign_np, exh_np, prev_fb, pw_a, nw_a, valid_a, stick_a,
+        gids_a, gv_a, constraints, rules)
+    if replaced:
+        # The fused diff/pack ran before the host fallback patched the
+        # flagged rows: re-derive both against the final assignment and
+        # rebuild the carry from it (one small extra dispatch on the
+        # escape-hatch path only).
+        from ..core.encode import pack_assignment
+        from ..moves.batch import diff_assignments
+
+        assign_np = patched
+        dev_assign = jnp.asarray(assign_np)
+        d_nodes, d_states, d_ops = diff_assignments(
+            jnp.asarray(prev_fb), dev_assign,
+            favor_min_nodes=favor_min_nodes)
+        packed, counts = pack_assignment(dev_assign)
+        carry = carry_from_assignment(
+            dev_assign, jnp.asarray(pw_a), jnp.asarray(nw_a))
+    else:
+        carry = SolveCarry(prices=prices, assign=assign, used=used)
+    return (assign_np, sweeps, carry,
+            (np.asarray(d_nodes), np.asarray(d_states),
+             np.asarray(d_ops)),
+            (np.asarray(packed), np.asarray(counts)))
+
+
 def solve_converged_resilient(
     prev, pweights, nweights, valid, stickiness, gids, gid_valid,
     constraints, rules, *, max_iterations: int, mode: str,
@@ -2181,6 +3134,12 @@ def solve_converged_resilient(
     rec = get_recorder()
 
     def run(m: str) -> np.ndarray:
+        # Structured refusal instead of an opaque XLA OOM when the
+        # matrix engine's projected [P, N] working set is over budget
+        # (checked per attempt: an auto-fallback onto the matrix engine
+        # must not sneak past the guard either).
+        check_dense_memory(prev.shape[0], prev.shape[1],
+                           np.asarray(nweights).shape[-1], m)
         # np.asarray inside the guarded region: async dispatch can defer
         # a runtime failure to the first host read.
         with rec.span("plan.solve.attempt", engine=m):
@@ -2690,42 +3649,60 @@ def plan_next_map_tpu(
     # unbucketed path lets the inner labels stand.
     obs_entry = _device.entry("solve_dense.bucketed") \
         if opts.shape_bucketing else contextlib.nullcontext()
+    use_sparse = _sparse_selected(opts, solve_p, problem.S, solve_n,
+                                  rules)
     with phase_span("plan.solve", timer=timer,
                     partitions=problem.P, nodes=problem.N,
+                    engine=("sparse" if use_sparse else None),
                     bucketed_shape=((solve_p, solve_n)
                                     if opts.shape_bucketing else None)), \
             obs_entry:
-        assign, _engine = solve_converged_resilient(
-            jnp.asarray(prev_a),
-            jnp.asarray(pw_a),
-            jnp.asarray(nw_a),
-            jnp.asarray(valid_a),
-            jnp.asarray(stick_a),
-            jnp.asarray(gids_a),
-            jnp.asarray(gv_a),
-            constraints,
-            rules,
-            max_iterations=max(int(opts.max_iterations), 1),
-            mode=resolve_default_fused_score(solve_p, solve_n),
-            allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
-            context="plan_next_map_tpu",
-            timer=timer,
-            # Only under bucketing: p_real keeps the fill denominator at
-            # the REAL partition count while sizes drift within a
-            # bucket.  Unbucketed solves keep total_p as a compile-time
-            # constant — a traced scalar changes how XLA
-            # strength-reduces the fill division, and those low bits
-            # flip jitter-level ties, perturbing the pinned fuzz
-            # contract for zero benefit on the default path.  (This is
-            # also why bucketed output is contract-equivalent to the
-            # unbucketed solve, not bit-identical.)
-            # device_put: the traced scalar must reach the device as an
-            # EXPLICIT transfer (a bare np scalar operand rides the
-            # eager convert primitive, which the tier-1 transfer-guard
-            # fixture in tests/conftest.py rejects as an implicit sync).
-            p_real=(jax.device_put(np.float32(problem.P))
-                    if opts.shape_bucketing else None),
-        )
+        if use_sparse:
+            assign = solve_sparse(
+                jnp.asarray(prev_a), jnp.asarray(pw_a),
+                jnp.asarray(nw_a), jnp.asarray(valid_a),
+                jnp.asarray(stick_a), jnp.asarray(gids_a),
+                jnp.asarray(gv_a), constraints, rules,
+                k=_opts_shortlist_k(opts, solve_n, constraints, rules),
+                max_iterations=max(int(opts.max_iterations), 1),
+                p_real=(jax.device_put(np.float32(problem.P))
+                        if opts.shape_bucketing else None))
+            if timer is not None:
+                timer.annotate("engine", "sparse")
+        else:
+            assign, _engine = solve_converged_resilient(
+                jnp.asarray(prev_a),
+                jnp.asarray(pw_a),
+                jnp.asarray(nw_a),
+                jnp.asarray(valid_a),
+                jnp.asarray(stick_a),
+                jnp.asarray(gids_a),
+                jnp.asarray(gv_a),
+                constraints,
+                rules,
+                max_iterations=max(int(opts.max_iterations), 1),
+                mode=resolve_default_fused_score(solve_p, solve_n),
+                allow_fallback=_FUSED_SCORE_DEFAULT == "auto",
+                context="plan_next_map_tpu",
+                timer=timer,
+                # Only under bucketing: p_real keeps the fill
+                # denominator at the REAL partition count while sizes
+                # drift within a bucket.  Unbucketed solves keep total_p
+                # as a compile-time constant — a traced scalar changes
+                # how XLA strength-reduces the fill division, and those
+                # low bits flip jitter-level ties, perturbing the pinned
+                # fuzz contract for zero benefit on the default path.
+                # (This is also why bucketed output is
+                # contract-equivalent to the unbucketed solve, not
+                # bit-identical.)
+                # device_put: the traced scalar must reach the device as
+                # an EXPLICIT transfer (a bare np scalar operand rides
+                # the eager convert primitive, which the tier-1
+                # transfer-guard fixture in tests/conftest.py rejects as
+                # an implicit sync).
+                p_real=(jax.device_put(np.float32(problem.P))
+                        if opts.shape_bucketing else None),
+            )
     assign = assign[:problem.P]  # bucketing pad rows are not real work
     maybe_validate(problem, assign, opts.validate_assignment,
                    "plan_next_map_tpu")

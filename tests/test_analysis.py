@@ -807,7 +807,7 @@ def test_shape_audit_passes_against_live_solver():
     findings, entries = run_shape_audit()
     rendered = "\n".join(f.render() for f in findings)
     assert findings == [], f"shape contract violations:\n{rendered}"
-    assert entries == len(CONTRACTS) + 2
+    assert entries == len(CONTRACTS) + 3
     # Acceptance coverage: warm, sharded and bucketed variants all audit.
     entry_names = {c.entry for c in CONTRACTS}
     assert {"solve_dense", "solve_dense_converged", "solve_dense_warm",
