@@ -87,6 +87,15 @@ def first_line(e):
     return (str(e).splitlines() or [""])[0][:200]
 
 
+def index_pct(xs, q):
+    """Nearest-rank percentile of a lag list (index formula shared by
+    the simulate and sched stages), rounded to ms; None when empty."""
+    xs = sorted(xs)
+    if not xs:
+        return None
+    return round(xs[min(int(q * len(xs)), len(xs) - 1)], 3)
+
+
 def save_progress(detail, stage):
     """Persist everything measured so far.  The driver only captures the
     final stdout JSON line; a tunnel wedge between stages would otherwise
@@ -478,12 +487,10 @@ def bench_simulate(seed=7, days=1.0):
 
     scn = mixed_week(seed, days=days)
     r = run_scenario(scn)
-    lags = sorted(r.convergence_lags)
+    lags = r.convergence_lags
 
     def pct(q):
-        if not lags:
-            return None
-        return round(lags[min(int(q * len(lags)), len(lags) - 1)], 3)
+        return index_pct(lags, q)
 
     s = r.summary
     out = {
@@ -592,6 +599,114 @@ def bench_costmodel(P=128, N=10, seed=5, fail_rate=0.25):
         f"{out['estimates']} (node,op) estimates, p50 rel err "
         f"{out['p50_rel_err']}, p95 {out['p95_rel_err']}, "
         f"roundtrip_ok={roundtrip_ok} in {total_ms:.0f}ms")
+    return out
+
+
+def bench_sched(seed=41):
+    """Sched stage (ISSUE 12): the critical-path scheduled move order vs
+    the legacy app-weight order at EXACTLY equal churn, scored on the
+    two scenario families the scheduler was built for — ``hetero_drain``
+    (one slow node, heterogeneous mover latencies: the showcase) and the
+    ``mixed_week`` soak.  Both runs replay under the DeterministicLoop
+    virtual clock, so every number here is exact and replayable, and the
+    committed ``hetero_drain`` trace is regenerated byte-for-byte as the
+    drift gate.
+
+    The identity half of the contract — same final map, same move set,
+    only the clock changes — is asserted, not just reported; ``gates``
+    collects every pass/fail the perf-smoke tier checks."""
+    import dataclasses
+
+    from blance_tpu.testing.scenarios import hetero_drain, mixed_week
+    from blance_tpu.testing.simulate import run_scenario
+
+    def p95(lags):
+        return index_pct(lags, 0.95)
+
+    def compare(scn, skip_incidents=0):
+        """Run one scenario legacy vs critical-path; the first
+        ``skip_incidents`` incidents are the cost model's calibration
+        pass (identical either way) and leave the makespan score."""
+        t0 = time.perf_counter()
+        leg = run_scenario(scn)
+        crit = run_scenario(
+            dataclasses.replace(scn, scheduler="critical_path"))
+        wall = time.perf_counter() - t0
+        leg_map = {k: v.nodes_by_state for k, v in leg.final_map.items()}
+        crit_map = {k: v.nodes_by_state
+                    for k, v in crit.final_map.items()}
+        leg_lags = leg.summary.first_converged_lags[skip_incidents:]
+        crit_lags = crit.summary.first_converged_lags[skip_incidents:]
+        return {
+            "scenario": scn.name, "seed": scn.seed,
+            "deltas": leg.deltas,
+            "identical_final_map": leg_map == crit_map,
+            "equal_churn": (leg.summary.moves_executed
+                            == crit.summary.moves_executed),
+            "moves_executed": leg.summary.moves_executed,
+            "moves_executed_scheduled": crit.summary.moves_executed,
+            "legacy": {
+                "makespan_p95_s": p95(leg_lags),
+                "makespan_total_s": round(sum(leg_lags), 3),
+                "convergence_lag_p95_s": p95(leg.convergence_lags),
+            },
+            "critical_path": {
+                "makespan_p95_s": p95(crit_lags),
+                "makespan_total_s": round(sum(crit_lags), 3),
+                "convergence_lag_p95_s": p95(crit.convergence_lags),
+            },
+            "wall_s": round(wall, 3),
+        }, crit
+
+    hetero, hetero_crit = compare(hetero_drain(seed), skip_incidents=1)
+    week, _ = compare(mixed_week(7))
+
+    # The committed replay trace is the CRITICAL-PATH account of the
+    # hetero_drain family: any drift in scheduler arithmetic (ranks,
+    # lane assignment, reschedule timing) shows up as a byte diff.
+    trace_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tests", "traces",
+                              f"sim_hetero_drain_s{seed}.json")
+    try:
+        with open(trace_path) as f:
+            replay_ok = f.read() == hetero_crit.log_text()
+    except OSError:
+        replay_ok = False
+
+    gates = {
+        "hetero_identical_final_map": hetero["identical_final_map"],
+        "hetero_equal_churn": hetero["equal_churn"],
+        # The headline: scheduled order must strictly beat app-weight
+        # order on the heterogeneous family's post-warmup makespan p95.
+        "hetero_makespan_win": (
+            hetero["critical_path"]["makespan_p95_s"]
+            < hetero["legacy"]["makespan_p95_s"]),
+        "hetero_trace_replay": replay_ok,
+        # The soak is fault-HEAVY: move order changes which moves land
+        # inside flaky windows, so the fault draws (and thus the exact
+        # retry churn) legitimately differ — the exact-equality identity
+        # claim lives on the fault-free hetero family and in the chaos
+        # tests with deterministic (dead-node) faults.  Here the gates
+        # are one-sided: scheduling must never BUY makespan with extra
+        # churn (at most 2% more moves) nor LENGTHEN the week's tail.
+        "week_no_extra_churn": (
+            week["moves_executed_scheduled"]
+            <= 1.02 * week["moves_executed"]),
+        "week_no_regression": (
+            week["critical_path"]["makespan_p95_s"]
+            <= week["legacy"]["makespan_p95_s"]),
+    }
+    out = {"hetero_drain": hetero, "mixed_week": week, "gates": gates,
+           "pass": all(gates.values())}
+    log(f"[sched hetero_drain seed={seed}] makespan p95 "
+        f"{hetero['legacy']['makespan_p95_s']}s legacy -> "
+        f"{hetero['critical_path']['makespan_p95_s']}s scheduled, "
+        f"equal_churn={hetero['equal_churn']} "
+        f"identical_map={hetero['identical_final_map']} "
+        f"replay_ok={replay_ok}; mixed_week p95 "
+        f"{week['legacy']['makespan_p95_s']}s -> "
+        f"{week['critical_path']['makespan_p95_s']}s "
+        f"pass={out['pass']}")
     return out
 
 
@@ -1648,12 +1763,27 @@ def _run_perf_smoke():
         sparse_ok = False
     ok = ok and sparse_ok
 
+    # Sched gate (ISSUE 12): the critical-path order must produce the
+    # identical final map and move count as the legacy order AND beat
+    # its makespan p95 on the heterogeneous family (no-regression on
+    # the mixed_week soak), with the committed hetero_drain trace
+    # regenerating byte-for-byte — all under the virtual clock, so the
+    # gate is exact, not wall-clock-noisy.
+    try:
+        sched = bench_sched()
+        sched_ok = sched["pass"]
+    except Exception as e:  # any stage crash must fail THIS gate, not
+        sched = {"error": first_line(e)}  # eat the results above it
+        sched_ok = False
+    ok = ok and sched_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
         "unit": "sweeps",
         "vs_baseline": res["cold_sweeps"],
-        "detail": {**res, "pipeline": pipe, "sparse": sparse},
+        "detail": {**res, "pipeline": pipe, "sparse": sparse,
+                   "sched": sched},
         "pass": ok,
     }))
     if not ok:
@@ -1828,6 +1958,17 @@ def _run_benchmarks(smoke, backend_note=None):
         log(f"costmodel stage failed ({type(e).__name__}: {first_line(e)})")
         detail["costmodel_error"] = first_line(e)
     save_progress(detail, "costmodel done")
+
+    # Sched stage: critical-path scheduled move order vs the legacy
+    # app-weight order at equal churn on hetero_drain + mixed_week —
+    # makespan / convergence-lag p95 both ways, identity + committed-
+    # trace-replay gates (ISSUE 12, docs/SCHEDULER.md).
+    try:
+        detail["sched"] = bench_sched()
+    except Exception as e:  # must not eat the solve numbers
+        log(f"sched stage failed ({type(e).__name__}: {first_line(e)})")
+        detail["sched_error"] = first_line(e)
+    save_progress(detail, "sched done")
 
     # Delta-replan stage: the incremental (warm-carry) replan against a
     # cold solve of the identical delta — cold vs warm sweeps and

@@ -92,6 +92,8 @@ SHARED_STATE: dict[str, frozenset[str]] = {
     "SloTracker": frozenset({
         "_placements", "_primaries", "_available", "moves_executed",
         "moves_failed", "_min_moves", "_t_last_progress", "_health",
+        "_incident_t0", "_incident_moves0", "_incident_fails0",
+        "_t_last_fail", "_first_converged_lags",
     }),
     "CostModel": frozenset({"_est", "_op_est", "_global", "_errors",
                             "_n_scored"}),
@@ -117,6 +119,21 @@ SHARED_STATE: dict[str, frozenset[str]] = {
         "_pending", "_wake", "_idle", "_inflight", "_stopping",
         "_task", "current", "_nodes", "_removing", "_failed",
         "failures", "degraded_reports", "warnings",
+    }),
+    # -- critical-path move scheduler (ISSUE 12) -----------------------------
+    # The bound scheduler's state is read by the supplier task (select)
+    # and mutated by mover tasks (on_batch marks progress,
+    # on_quarantine rebuilds the whole schedule) plus the supplier's
+    # wind-down (finish).  Discipline: every mutator is a plain sync
+    # method — _build recomputes ranks/plan/last_remaining in ONE
+    # no-await window, so select can never observe a half-rebuilt
+    # schedule, and the (plan, last_remaining) pair is always a
+    # consistent snapshot (the reschedule_on_quarantine explorer
+    # scenario checks that dynamically).  SloTracker's incident fields
+    # follow its existing single-window discipline.
+    "_CriticalPathBound": frozenset({
+        "_rank", "plan", "last_remaining", "_quarantined",
+        "_t_last_exec", "_first_predicted", "_finished", "reschedules",
     }),
 }
 

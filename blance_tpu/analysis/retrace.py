@@ -53,6 +53,10 @@ RETRACE_BUDGETS: dict[str, int] = {
     # entry and compiles only the repair program.
     "sparse.cold": 3,
     "sparse.warm": 2,
+    # The critical-path scheduler's rank sweep (orchestrate/sched/
+    # ranks.py): one jitted program per [P, L] shape, dispatched 4x at
+    # one shape in the workload.
+    "sched.ranks": 2,
     "fleet.cold": 3,
     "fleet.warm": 3,
     # The shard_map dispatch legitimately compiles many sub-programs
@@ -153,6 +157,15 @@ def _workload() -> None:
             "primary": [nodes[i % n_real]],
             "replica": [nodes[(i + 1) % n_real]]}) for i in range(24)}
         plan_next_map_tpu(pmap, pmap, nodes, [], [], m, opts)
+
+    # sched.ranks — the scheduler's device rank sweep: four dispatches
+    # of one [P, L] cost matrix; the device threshold is forced to 0 so
+    # the jitted path runs regardless of the move count.
+    from ..orchestrate.sched.ranks import upward_ranks
+
+    chain_costs = [[0.5, 1.0, 0.25]] * 16 + [[2.0, 0.5]] * 16
+    for _ in range(4):
+        upward_ranks(chain_costs, device_threshold=0)
 
     # sparse.cold + sparse.warm — the shortlist engine at one
     # (shape, K): four cold dispatches (builder + fixpoint compile once,

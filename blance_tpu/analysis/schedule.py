@@ -564,6 +564,84 @@ async def _slo_gauges_under_chaos() -> dict[str, int]:
             "failed": slo.moves_failed}
 
 
+async def _reschedule_on_quarantine() -> dict[str, int]:
+    """The critical-path scheduler's online-reschedule path (ISSUE 12):
+    a breaker trip mid-schedule must rebuild the plan in one atomic
+    window — under EVERY interleaving the rebuilt (plan, remaining)
+    snapshot pair stays consistent:
+
+    - every unfinished move reappears in the rebuilt schedule exactly
+      once (scheduled on a lane XOR stalled, never both, none lost);
+    - no orphan lanes: nothing is scheduled onto a quarantined node,
+      and every lane index is within the machine's capacity;
+    - cursors never reverse and failed_at is write-once (the standard
+      ProgressInvariants), with achieved_map consistent against the
+      independently logged assign batches."""
+    from ..obs import Recorder, use_recorder
+    from ..orchestrate.sched import CriticalPathScheduler
+    from ..orchestrate.sched.policy import _CriticalPathBound
+
+    loop = asyncio.get_running_loop()
+    beg = _pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+    end = _pm({"p0": {"primary": ["dead"]}, "p1": {"primary": ["dead"]},
+               "p2": {"primary": ["b"]}, "p3": {"primary": ["b"]}})
+    plan = FaultPlan(seed=17, nodes={"dead": NodeFaults(dead=True)})
+    executed: list[tuple[str, tuple[str, ...], tuple[str, ...],
+                         tuple[str, ...]]] = []
+    max_lanes = 2
+    with use_recorder(Recorder(clock=loop.time)):
+        o = orchestrate_moves(
+            _MODEL,
+            OrchestratorOptions(
+                move_timeout_s=0.25, max_retries=0, quarantine_after=1,
+                probe_after_s=60.0,
+                max_concurrent_partition_moves_per_node=max_lanes,
+                scheduler=CriticalPathScheduler()),
+            ["a", "b", "dead"], beg, end,
+            plan.wrap(_logging_assign(executed)))
+        bound = o.sched
+        assert isinstance(bound, _CriticalPathBound)
+        inv = ProgressInvariants(o, ft_errors_structured=True)
+        async for progress in o.progress_ch():
+            inv.observe(progress)
+            # The (plan, last_remaining) pair must be consistent at
+            # EVERY observation point, not just at the end — _build
+            # writes both in one no-await window.
+            keys = [(m.partition, m.index) for m in bound.plan.moves]
+            if len(set(keys)) != len(keys):
+                raise InvariantViolation(
+                    f"duplicate moves in the schedule: {keys!r}")
+            all_keys = set(keys) | set(bound.plan.stalled)
+            if len(keys) + len(bound.plan.stalled) != len(all_keys):
+                raise InvariantViolation(
+                    "a move is both scheduled and stalled: "
+                    f"{keys!r} / {bound.plan.stalled!r}")
+            if all_keys != set(bound.last_remaining):
+                raise InvariantViolation(
+                    "rebuilt schedule diverges from the remaining set: "
+                    f"plan+stalled={sorted(all_keys)!r} vs "
+                    f"remaining={sorted(bound.last_remaining)!r}")
+            for mv in bound.plan.moves:
+                if mv.node in bound.quarantined():
+                    raise InvariantViolation(
+                        f"orphan lane: {mv!r} scheduled onto "
+                        f"quarantined node {mv.node!r}")
+                if not 0 <= mv.lane < max_lanes:
+                    raise InvariantViolation(
+                        f"lane {mv.lane} outside machine capacity "
+                        f"{max_lanes} for {mv!r}")
+        o.stop()
+        inv.finish(executed=executed)
+        if o._progress.tot_quarantine_trips < 1:
+            raise InvariantViolation("breaker never tripped — scenario "
+                                     "drifted from the code under test")
+        if bound.reschedules < 1:
+            raise InvariantViolation(
+                "quarantine trip did not trigger a reschedule")
+    return {"snapshots": inv.snapshots, "reschedules": bound.reschedules,
+            "trips": o._progress.tot_quarantine_trips}
+
+
 async def _supersede_mid_rebalance() -> dict[str, int]:
     """The continuous-rebalance controller's supersede path: a second
     cluster delta fired from INSIDE the first transition's assign
@@ -678,6 +756,13 @@ SCENARIOS: dict[str, Scenario] = {
             doc="SLO gauges stay well-formed and agree with the "
                 "achieved map under chaos (seeded chaos walks)",
             factory=_slo_gauges_under_chaos),
+        Scenario(
+            name="reschedule_on_quarantine",
+            doc="a breaker trip mid-schedule rebuilds the critical-"
+                "path plan: every unfinished move exactly once, no "
+                "orphan lanes, cursors never reverse (seeded chaos "
+                "walks)",
+            factory=_reschedule_on_quarantine),
         Scenario(
             name="supersede_mid_rebalance",
             doc="a delta mid-rebalance cancels cleanly (no orphan "

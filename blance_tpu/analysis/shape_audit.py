@@ -538,6 +538,24 @@ def _expect_fleet_warm(d: Dims, b: int = _FLEET_B):
 
 # -- the table --------------------------------------------------------------
 
+def _build_sched_ranks(d: Dims):
+    import numpy as np
+
+    from ..orchestrate.sched.ranks import rank_levels
+
+    # The critical-path scheduler's device rank sweep: [P, L] per-move
+    # costs (chains x levels, zero-padded) -> [P, L] upward ranks.  L
+    # here is a representative 4-move chain depth (promote/add + del +
+    # repair is 3; 4 covers a demote leg).
+    return rank_levels, (_sds((d.P, 4), np.float32),), {}
+
+
+def _expect_sched_ranks(d: Dims):
+    import numpy as np
+
+    return ((d.P, 4), np.float32)
+
+
 # The audit matrix: small/typical/awkward sizes.  P values are multiples
 # of 8 so the sharded variant exercises a real multi-shard mesh on the 8
 # virtual CPU devices CI forces (a non-divisible P still audits, on a
@@ -697,6 +715,13 @@ CONTRACTS: tuple[ShapeContract, ...] = tuple(
             entry="plan_pipeline_sharded", variant=f"warm@{d.P}x{d.N}",
             build=(lambda d=d: _build_pipeline_sharded(d, warm=True)),
             expect=(lambda d=d: _expect_pipeline_warm(d)))
+        for d in _MATRIX
+    ] + [
+        # -- critical-path scheduler device rank kernel (ISSUE 12) -----
+        ShapeContract(
+            entry="sched_rank_levels", variant=f"chains@{d.P}",
+            build=(lambda d=d: _build_sched_ranks(d)),
+            expect=(lambda d=d: _expect_sched_ranks(d)))
         for d in _MATRIX
     ]
 )

@@ -38,13 +38,38 @@ from typing import Any, Optional, TextIO, Union
 
 from .recorder import Recorder, Span, get_recorder, percentile
 
-__all__ = ["CostModel", "EXEC_SPAN"]
+__all__ = ["CostModel", "EXEC_SPAN", "DEFAULT_PRIORS_PATH",
+           "default_op_priors"]
 
 # The move-lifecycle span the model learns from: the app-callback
 # execution child, which carries node= and ops= attributes.
 EXEC_SPAN = "orchestrate.move.exec"
 
 _FORMAT_VERSION = 1
+_PRIORS_VERSION = 1
+
+# The committed bench calibration: per-op EWMA aggregates measured by
+# bench.py's costmodel stage (regenerate from its ``op_priors_s``
+# output).  Seeding these as op-level priors means a scheduler on a
+# NEVER-OBSERVED cluster already prices a del cheaper than an add
+# instead of running uniform-cost (ISSUE 12 satellite).
+DEFAULT_PRIORS_PATH = os.path.join(os.path.dirname(__file__),
+                                   "costmodel_priors.json")
+
+
+def default_op_priors(path: Optional[str] = None) -> dict[str, float]:
+    """Load the committed per-op prior table: op kind -> seconds.
+    Raises on a version mismatch (regenerate the file from the bench
+    costmodel stage) so a stale format can never silently mis-seed."""
+    with open(path if path is not None else DEFAULT_PRIORS_PATH) as f:
+        data = json.load(f)
+    version = data.get("version")
+    if version != _PRIORS_VERSION:
+        raise ValueError(
+            f"cost-model priors version {version!r} != {_PRIORS_VERSION}"
+            f" (regenerate the file from the bench costmodel stage)")
+    return {str(op): float(s)
+            for op, s in data["op_priors_s"].items()}
 
 
 class CostModel:
@@ -125,14 +150,41 @@ class CostModel:
             agg[1] += 1
         rec.count("costmodel.updates")
 
+    # -- cold-start priors ----------------------------------------------------
+
+    def seed_priors(self, op_priors_s: "dict[str, float]",
+                    n: int = 1) -> None:
+        """Seed op-level fallback estimates (op kind -> seconds) for
+        ops with NO observations yet — the committed bench calibration
+        (``default_op_priors``) is the canonical source.  Live
+        observations take over through the normal EWMA fold; aggregates
+        that already learned from real spans are never overwritten."""
+        for op, s in op_priors_s.items():
+            agg = self._op_est.get(op)
+            if agg is None or agg[1] == 0:
+                self._op_est[op] = [float(s), max(int(n), 1)]
+
+    @classmethod
+    def with_priors(cls, path: Optional[str] = None,
+                    **kwargs: Any) -> "CostModel":
+        """A fresh model seeded from the committed bench calibration
+        file — the scheduler's cold-start spelling."""
+        model = cls(**kwargs)
+        model.seed_priors(default_op_priors(path))
+        return model
+
     # -- the scheduler-facing API ---------------------------------------------
 
     def predict(self, node: str, op: str) -> float:
         """Estimated seconds for one (node, op) move — exact estimate,
-        else op aggregate, else global aggregate, else default."""
+        else op aggregate, else global aggregate, else default.  Every
+        answer below the exact level counts ``costmodel.cold_predictions``
+        so dashboards can see how much of a schedule ran on priors."""
         est = self._est.get((node, op))
         if est is not None:
             return float(est[0])
+        rec = self._rec if self._rec is not None else get_recorder()
+        rec.count("costmodel.cold_predictions")
         agg = self._op_est.get(op)
         if agg is not None and agg[1] > 0:
             return float(agg[0])

@@ -267,6 +267,35 @@ def default_registry() -> MetricsRegistry:
         Metric("slo.violation_seconds", "gauge",
                "cumulative seconds availability sat below the "
                "configured SLO floor"),
+        Metric("slo.first_converged_lag_s", "gauge",
+               "per-incident seconds from incident open to the last "
+               "required move executed (the rebalance makespan the "
+               "scheduler minimizes; last closed incident)"),
+        # -- sched (orchestrate/sched; docs/SCHEDULER.md) ---------------------
+        Metric("sched.makespan_predicted_s", "gauge",
+               "list-scheduled makespan of the current move DAG on the "
+               "node lanes, priced by the calibrated cost model"),
+        Metric("sched.makespan_actual_s", "gauge",
+               "achieved makespan of the finished orchestration (bind "
+               "to last executed move)"),
+        Metric("sched.critical_path_s", "gauge",
+               "longest scheduled dependency chain by predicted cost "
+               "(the makespan lower bound; stalled tails excluded)"),
+        Metric("sched.lane_utilization", "gauge",
+               "predicted busy fraction of the active nodes' lanes "
+               "across the scheduled makespan"),
+        Metric("sched.makespan_rel_err", "histogram",
+               "relative error of the predicted vs achieved makespan, "
+               "scored as each orchestration winds down"),
+        Metric("sched.reschedules", "counter",
+               "online schedule rebuilds (health-breaker quarantine "
+               "or heal mid-schedule)"),
+        Metric("sched.host_ranks", "counter",
+               "upward-rank sweeps computed on host (move set below "
+               "the device threshold)"),
+        Metric("sched.device_ranks", "counter",
+               "upward-rank sweeps dispatched on device (jitted "
+               "leveled-DAG scan)"),
         # -- sim (rebalance.RebalanceController + testing/simulate.py) -------
         Metric("sim.events", "counter",
                "scenario trace events applied by the simulator driver"),
@@ -289,6 +318,9 @@ def default_registry() -> MetricsRegistry:
         Metric("costmodel.rel_err", "histogram",
                "relative error of the cost prediction vs the observed "
                "per-move cost, at update time"),
+        Metric("costmodel.cold_predictions", "counter",
+               "predictions served without an exact (node, op) "
+               "estimate (op-prior / global / default fallback)"),
         # -- fleet (plan/fleet.py + plan/service.py) -------------------------
         Metric("fleet.requests", "counter",
                "tenant plan requests submitted to the plan service"),

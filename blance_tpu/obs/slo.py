@@ -91,6 +91,15 @@ class SloSummary:
     partitions: int
     available_partitions: int
     quarantine_exposure_s: dict[str, float] = field(default_factory=dict)
+    # Per-incident makespan accounting (ISSUE 12 satellite): seconds
+    # from incident open to the LAST required move executed, one entry
+    # per closed incident.  ``convergence_lag_s`` ("seconds since the
+    # last executed move") under-reports during a long scheduled tail —
+    # moves keep landing, so the gauge hugs zero while the rebalance is
+    # still hours from done; this is the honest time-to-converged the
+    # critical-path scheduler minimizes.  None until an incident closed.
+    first_converged_lag_s: Optional[float] = None
+    first_converged_lags: list[float] = field(default_factory=list)
     # -- horizon accounting (None/empty unless track_timeline was on) --
     time_weighted_availability: Optional[float] = None
     availability_floor: Optional[float] = None
@@ -142,6 +151,16 @@ class SloTracker:
         self.moves_failed = 0
         self._t_last_progress = self._clock()
         self._health: Optional[Any] = None
+        # Incident accounting: open at the event that starts a
+        # rebalance episode (delta submission / rebalance entry), close
+        # at its quiesce; the lag is measured to the LAST executed move
+        # inside the incident, so debounce/planning idle after the
+        # final move never inflates it.
+        self._incident_t0: Optional[float] = None
+        self._incident_moves0 = 0
+        self._incident_fails0 = 0
+        self._t_last_fail: Optional[float] = None
+        self._first_converged_lags: list[float] = []
         # Horizon accounting: a step timeline of (t, availability),
         # appended only on CHANGE (plus the seed point), so the
         # integral below is a plain fold over it.
@@ -165,6 +184,59 @@ class SloTracker:
         if health is not None:
             self._health = health
 
+    # -- incident (makespan) accounting ---------------------------------------
+
+    def open_incident(self, t: Optional[float] = None) -> None:
+        """Mark the start of a rebalance incident (a cluster delta, a
+        rebalance call).  First open wins until the incident closes, so
+        a burst of coalesced deltas reads as ONE incident measured from
+        its first event."""
+        if self._incident_t0 is None:
+            self._incident_t0 = self._clock() if t is None else t
+            self._incident_moves0 = self.moves_executed
+            self._incident_fails0 = self.moves_failed
+
+    def close_incident(self, t: Optional[float] = None) -> Optional[float]:
+        """Close the open incident (the control loop quiesced / the
+        rebalance returned) and record its time-to-converged: incident
+        open to the last executed move — 0.0 when the incident needed
+        no moves.  An incident whose execution TAIL is failures (fails
+        after the last execute, or no execute at all) never converged,
+        so its lag is the whole open-to-close window (a lower bound),
+        never a deflated time-to-last-execute; a failure that a retry
+        or recovery round then executed past still reads as converged.
+        Publishes ``slo.first_converged_lag_s``; returns the lag (None
+        when no incident was open)."""
+        if self._incident_t0 is None:
+            return None
+        executed = self.moves_executed > self._incident_moves0
+        failed = self.moves_failed > self._incident_fails0
+        fail_tail = failed and self._t_last_fail is not None and (
+            not executed or self._t_last_fail > self._t_last_progress)
+        if executed and not fail_tail:
+            lag = max(self._t_last_progress - self._incident_t0, 0.0)
+        elif fail_tail:
+            t_close = self._clock() if t is None else t
+            lag = max(t_close - self._incident_t0, 0.0)
+        else:
+            lag = 0.0
+        self._first_converged_lags.append(lag)
+        self._incident_t0 = None
+        self.publish(t)
+        return lag
+
+    def discard_incident(self) -> None:
+        """Drop the open incident WITHOUT recording a lag — the caller
+        raised out of the episode (validation error, planner crash), so
+        there is no makespan to account and the next episode's
+        ``open_incident`` must not read a stale start.  No-op when
+        nothing is open."""
+        self._incident_t0 = None
+
+    def first_converged_lags(self) -> list[float]:
+        """Per-incident time-to-converged samples, in close order."""
+        return list(self._first_converged_lags)
+
     # -- the orchestrator hook ------------------------------------------------
 
     def on_batch(self, node: str, moves: Sequence[Any], ok: bool,
@@ -182,6 +254,7 @@ class SloTracker:
             self._note_availability(now)
         else:
             self.moves_failed += len(moves)
+            self._t_last_fail = now
         self.publish(now)
 
     def _apply(self, mv: Any) -> None:
@@ -325,6 +398,9 @@ class SloTracker:
         rec.set_gauge("slo.moves_executed", self.moves_executed)
         rec.set_gauge("slo.moves_failed", self.moves_failed)
         rec.set_gauge("slo.min_moves", self._min_moves)
+        if self._first_converged_lags:
+            rec.set_gauge("slo.first_converged_lag_s",
+                          self._first_converged_lags[-1])
         if self._timeline is not None:
             rec.set_gauge("slo.time_weighted_availability",
                           self.time_weighted_availability(t))
@@ -351,6 +427,10 @@ class SloTracker:
             partitions=self._total,
             available_partitions=self._available,
             quarantine_exposure_s=self.quarantine_exposure_s(),
+            first_converged_lag_s=(self._first_converged_lags[-1]
+                                   if self._first_converged_lags
+                                   else None),
+            first_converged_lags=list(self._first_converged_lags),
             time_weighted_availability=(
                 self.time_weighted_availability(t)
                 if self._timeline is not None else None),

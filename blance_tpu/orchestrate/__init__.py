@@ -3,6 +3,12 @@
 from .csp import GET, PUT, Chan, ChanClosed, select
 from .faults import FaultInjected, FaultPlan, NodeFaults
 from .health import HALF_OPEN, HEALTHY, QUARANTINED, HealthTracker, NodeHealth
+from .sched import (
+    CriticalPathScheduler,
+    LegacyWeightOrder,
+    SchedulePlan,
+    SchedulerPolicy,
+)
 from .orchestrator import (
     MOVE_OP_WEIGHT,
     ErrorInterrupt,
@@ -48,4 +54,8 @@ __all__ = [
     "PartitionMove",
     "lowest_weight_partition_move_for_node",
     "orchestrate_moves",
+    "CriticalPathScheduler",
+    "LegacyWeightOrder",
+    "SchedulePlan",
+    "SchedulerPolicy",
 ]

@@ -80,16 +80,19 @@ class HealthTracker:
 
     # -- outcome reporting ---------------------------------------------------
 
-    def record_success(self, node: str) -> None:
+    def record_success(self, node: str) -> bool:
         """A callback attempt for ``node`` succeeded: half-open heals,
-        failure streaks reset."""
+        failure streaks reset.  Returns True when THIS success healed a
+        quarantined/half-open node (the breaker's heal transition)."""
         h = self._get(node)
-        if h.state in (QUARANTINED, HALF_OPEN):
+        healed = h.state in (QUARANTINED, HALF_OPEN)
+        if healed:
             # Close the open quarantine interval into the exposure total.
             h.exposure_s += max(self.clock() - h.tripped_at, 0.0)
         h.consecutive_failures = 0
         h.probe_in_flight = False
         h.state = HEALTHY
+        return healed
 
     def record_failure(self, node: str) -> bool:
         """A callback attempt for ``node`` failed or timed out.  Returns

@@ -33,6 +33,16 @@ from ..obs import get_recorder
 from ..plan.greedy import sort_state_names
 from .csp import Chan, select, GET, PUT
 from .health import HealthTracker
+# The app-weight ordering lives in the sched package now (ISSUE 12:
+# LegacyWeightOrder behind the scheduler interface); re-exported here
+# unchanged so every existing import site keeps working.
+from .sched.policy import (
+    MOVE_OP_WEIGHT,
+    BoundScheduler,
+    LegacyWeightOrder,
+    SchedulerPolicy,
+    lowest_weight_partition_move_for_node,
+)
 
 if TYPE_CHECKING:  # annotation-only; obs.slo must not import us back
     from ..obs.slo import MoveObserver
@@ -179,6 +189,14 @@ class OrchestratorOptions:
     # on-device diff (moves/batch.py) instead of the per-partition host
     # loop.  Identical op lists; worthwhile from ~10k partitions up.
     device_diff: bool = False
+    # Move-ordering policy (orchestrate/sched, docs/SCHEDULER.md).
+    # None = the reference's app-weight order (LegacyWeightOrder), the
+    # pinned default.  CriticalPathScheduler turns the flat move list
+    # into a critical-path-prioritized schedule minimizing rebalance
+    # MAKESPAN on calibrated per-(node, op) costs — the final map and
+    # move set stay bit-identical, only the order (and the clock)
+    # changes.  Mutually exclusive with a custom find_move callback.
+    scheduler: Optional[SchedulerPolicy] = None
 
 
 @dataclass
@@ -237,24 +255,6 @@ class PartitionMove:
     node: str
     state: str  # "" means removal
     op: str  # "add" | "del" | "promote" | "demote"
-
-
-MOVE_OP_WEIGHT = {"promote": 1, "demote": 2, "add": 3, "del": 4}
-
-
-def lowest_weight_partition_move_for_node(
-    node: str, moves: list[PartitionMove]
-) -> int:
-    """Default FindMoveFunc: index of the lightest op (orchestrate.go:177-186).
-
-    First-lowest wins ties, so single-node promotions/demotions go first and
-    clients regain coverage quickly.
-    """
-    r = 0
-    for i, move in enumerate(moves):
-        if MOVE_OP_WEIGHT.get(moves[r].op, 0) > MOVE_OP_WEIGHT.get(move.op, 0):
-            r = i
-    return r
 
 
 class NextMoves:
@@ -350,6 +350,26 @@ class Orchestrator:
         # SLO plane's incremental achieved-map delta feed.  Immutable
         # after init; callbacks must be plain sync code.
         self._observers: "tuple[MoveObserver, ...]" = tuple(move_observers)
+
+        # Move-ordering policy (orchestrate/sched): every run binds one
+        # — LegacyWeightOrder when options leave the default, which
+        # selects byte-identically to the pre-extraction app-weight
+        # code.  A custom find_move callback and a scheduler are
+        # mutually exclusive: both claim the same decision.
+        policy = options.scheduler
+        if policy is not None and \
+                self._find_move is not lowest_weight_partition_move_for_node:
+            raise ValueError(
+                "OrchestratorOptions.scheduler and a custom find_move "
+                "callback are mutually exclusive — both decide which "
+                "move a node runs next")
+        if policy is None:
+            policy = LegacyWeightOrder()
+        self.sched: BoundScheduler = policy.bind(
+            nodes_all, map_partition_to_next_moves,
+            options.max_concurrent_partition_moves_per_node, self._rec)
+        if self.sched.observes_batches:
+            self._observers = self._observers + (self.sched,)
 
         # -- fault tolerance (all inert when options keep the defaults) --
         self._ft = options.fault_tolerant
@@ -607,14 +627,21 @@ class Orchestrator:
             err = await self._call_assign(stop_ch, node, partitions,
                                           states, ops)
             if err is None:
-                if self.health is not None:
-                    self.health.record_success(node)
+                if self.health is not None and \
+                        self.health.record_success(node):
+                    # The probe healed the node: its lanes rejoin the
+                    # machine model (no-op for legacy order).
+                    self.sched.on_heal(node)
                 return None, attempt
             tripped = False
             if self.health is not None:
                 tripped = self.health.record_failure(node)
                 if tripped:
                     self._bump_sync("tot_quarantine_trips")
+                    # Online reschedule: the node's lanes just left the
+                    # machine model; the scheduler rebuilds priorities
+                    # from the remaining DAG (no-op for legacy order).
+                    self.sched.on_quarantine(node)
             if not self._ft or attempt >= max_attempts or tripped:
                 return err, attempt
             delay = opts.backoff_base_s * (2.0 ** (attempt - 1))
@@ -777,13 +804,14 @@ class Orchestrator:
     def _find_next_moves(self, node: str, next_moves_arr: list[NextMoves]) -> int:
         """Ask the app which available move to do next (orchestrate.go:699-714)."""
         if self._find_move is lowest_weight_partition_move_for_node:
-            # Fast path for the default policy: it reads only each
-            # candidate's .op, which the cursor's NodeStateOp already
-            # carries — hand it those directly instead of materializing
-            # PartitionMove views (measured ~50% of scheduler time at 8k
-            # partitions).  One copy of the policy semantics either way.
-            return lowest_weight_partition_move_for_node(
-                node, [nm.moves[nm.next] for nm in next_moves_arr])
+            # Scheduler path (default LegacyWeightOrder, or the policy
+            # the options set): selection reads the live cursors
+            # directly — the legacy bound hands each candidate's
+            # op-bearing NodeStateOp straight to the weight rule, the
+            # exact pre-extraction fast path (measured ~50% of
+            # scheduler time at 8k partitions), and the critical-path
+            # bound looks up (partition, cursor) upward ranks.
+            return self.sched.select(node, next_moves_arr)
         moves = [
             PartitionMove(
                 partition=nm.partition,
@@ -929,6 +957,10 @@ class Orchestrator:
         await self._update_progress(count_done)
 
         await self._wait_for_all_movers_done(run_mover_done_ch)
+
+        # Scheduler wind-down: scores predicted-vs-actual makespan
+        # (sched.makespan_rel_err) now that the last move has landed.
+        self.sched.finish(self._rec.now())
 
         await self._bump("tot_progress_close")
 

@@ -304,200 +304,214 @@ async def rebalance_async(
             primary_states=[s for s, st in model.items()
                             if st.priority == top],
             clock=rec.now, recorder=rec)
-    opts = orchestrator_options or OrchestratorOptions()
-    ft = opts.fault_tolerant
-    if max_recovery_rounds > 0 and not ft:
-        raise ValueError(
-            "max_recovery_rounds needs fault-tolerant orchestrator options "
-            "(move_timeout_s / max_retries / quarantine_after): the legacy "
-            "path aborts on the first error and records no failures to "
-            "recover from")
+    # One rebalance call is one SLO incident: its time-to-converged
+    # (slo.first_converged_lag_s — entry to the last required move) is
+    # the makespan the critical-path scheduler minimizes; the rolling
+    # convergence-lag gauge alone would under-report a long scheduled
+    # tail (it resets on every executed move).
+    slo.open_incident()
+    try:
+        opts = orchestrator_options or OrchestratorOptions()
+        ft = opts.fault_tolerant
+        if max_recovery_rounds > 0 and not ft:
+            raise ValueError(
+                "max_recovery_rounds needs fault-tolerant orchestrator options "
+                "(move_timeout_s / max_retries / quarantine_after): the legacy "
+                "path aborts on the first error and records no failures to "
+                "recover from")
 
-    all_warnings: dict[str, list[str]] = {}
+        all_warnings: dict[str, list[str]] = {}
 
-    def plan(cur: PartitionMap, removes: list[str], adds: list[str],
-             warm_ok: bool, recovery: bool) -> PartitionMap:
-        """One planner entry; merges warnings.  With a session: adopt
-        ``cur`` unless the session's adopted state already matches
-        (warm_ok — the recovery fast path), apply the delta, replan.
-        Recovery rounds go through the session's dedicated entry
-        (``recovery_replan``) so the failure-aware replan has exactly
-        one spelling."""
-        if session is None:
-            next_map, warns = plan_next_map(
-                cur, cur, nodes_all, removes, adds, model,
-                plan_options, backend=backend)
-        else:
-            if not warm_ok and not _session_matches(session, cur):
-                session.load_map(cur)  # cold: invalidates any carry
-            if recovery:
-                session.recovery_replan(removes)  # adds is always [] here
+        def plan(cur: PartitionMap, removes: list[str], adds: list[str],
+                 warm_ok: bool, recovery: bool) -> PartitionMap:
+            """One planner entry; merges warnings.  With a session: adopt
+            ``cur`` unless the session's adopted state already matches
+            (warm_ok — the recovery fast path), apply the delta, replan.
+            Recovery rounds go through the session's dedicated entry
+            (``recovery_replan``) so the failure-aware replan has exactly
+            one spelling."""
+            if session is None:
+                next_map, warns = plan_next_map(
+                    cur, cur, nodes_all, removes, adds, model,
+                    plan_options, backend=backend)
             else:
-                if adds:
-                    session.add_nodes(adds)
-                if removes:
-                    session.remove_nodes(removes)
-                session.replan()
-            next_map, warns = session.to_map("proposed")
-        for k, v in warns.items():
-            all_warnings.setdefault(k, []).extend(v)
-        return next_map
+                if not warm_ok and not _session_matches(session, cur):
+                    session.load_map(cur)  # cold: invalidates any carry
+                if recovery:
+                    session.recovery_replan(removes)  # adds is always [] here
+                else:
+                    if adds:
+                        session.add_nodes(adds)
+                    if removes:
+                        session.remove_nodes(removes)
+                    session.replan()
+                next_map, warns = session.to_map("proposed")
+            for k, v in warns.items():
+                all_warnings.setdefault(k, []).extend(v)
+            return next_map
 
-    beg = current_map
-    removes = list(nodes_to_remove or [])
-    adds = list(nodes_to_add or [])
-    rounds: list[RecoveryRound] = []
-    all_failures: list[MoveFailure] = []
-    events_total = 0
-    health = opts.health
-    warm_ok = False
-    final: OrchestratorProgress = OrchestratorProgress()
-    next_map: PartitionMap = beg
-    achieved: Optional[PartitionMap] = None
-    quarantined: list[str] = []
-    round_failures: list[MoveFailure] = []
-    degraded: Optional[DegradedPlacement] = None
+        beg = current_map
+        removes = list(nodes_to_remove or [])
+        adds = list(nodes_to_add or [])
+        rounds: list[RecoveryRound] = []
+        all_failures: list[MoveFailure] = []
+        events_total = 0
+        health = opts.health
+        warm_ok = False
+        final: OrchestratorProgress = OrchestratorProgress()
+        next_map: PartitionMap = beg
+        achieved: Optional[PartitionMap] = None
+        quarantined: list[str] = []
+        round_failures: list[MoveFailure] = []
+        degraded: Optional[DegradedPlacement] = None
 
-    for round_i in range(1 + max(max_recovery_rounds, 0)):
-        if round_i > 0 and not [n for n in nodes_all if n not in removes]:
-            # Every node is removed/quarantined: a recovery replan has
-            # an EMPTY candidate set.  The achieved map was already
-            # stripped of every dead placement, so the honest target is
-            # the empty placement — surfaced as a structured
-            # degradation, not a planner round that can place nothing
-            # (and not a raise: the simulator's zone-outage scenarios
-            # hit this in normal operation).
-            degraded = DegradedPlacement(
-                reason="no-candidate-nodes", nodes_available=0,
-                partitions=len(beg))
-            rec.count("rebalance.degraded")
-            next_map = {name: Partition(name, {s: [] for s in model})
-                        for name in beg}
-            break
-        phase = "plan" if round_i == 0 else f"recovery_plan_{round_i}"
-        with timer.phase(phase):
-            next_map = plan(beg, removes, adds, warm_ok,
-                            recovery=round_i > 0)
+        for round_i in range(1 + max(max_recovery_rounds, 0)):
+            if round_i > 0 and not [n for n in nodes_all if n not in removes]:
+                # Every node is removed/quarantined: a recovery replan has
+                # an EMPTY candidate set.  The achieved map was already
+                # stripped of every dead placement, so the honest target is
+                # the empty placement — surfaced as a structured
+                # degradation, not a planner round that can place nothing
+                # (and not a raise: the simulator's zone-outage scenarios
+                # hit this in normal operation).
+                degraded = DegradedPlacement(
+                    reason="no-candidate-nodes", nodes_available=0,
+                    partitions=len(beg))
+                rec.count("rebalance.degraded")
+                next_map = {name: Partition(name, {s: [] for s in model})
+                            for name in beg}
+                break
+            phase = "plan" if round_i == 0 else f"recovery_plan_{round_i}"
+            with timer.phase(phase):
+                next_map = plan(beg, removes, adds, warm_ok,
+                                recovery=round_i > 0)
 
-        if checkpoint_path:
-            with timer.phase("checkpoint"):
-                save_partition_map(next_map, checkpoint_path)
+            if checkpoint_path:
+                with timer.phase("checkpoint"):
+                    save_partition_map(next_map, checkpoint_path)
 
-        events = 0
-        orch_phase = "orchestrate" if round_i == 0 \
-            else f"recovery_orchestrate_{round_i}"
-        with timer.phase(orch_phase):
-            round_opts = opts
-            if ft and health is not None:
-                # Quarantine state carries across rounds: a node that
-                # tripped in round k stays dark in round k+1 unless its
-                # half-open probe heals it.
-                round_opts = dataclasses.replace(opts, health=health)
-            orch_nodes = [n for n in nodes_all if n not in quarantined]
-            o = orchestrate_moves(
-                model,
-                round_opts,
-                orch_nodes,
-                beg,
-                next_map,
-                assign_partitions,
-                find_move or lowest_weight_partition_move_for_node,
-                move_observers=(slo,),
-            )
-            if round_i == 0:
-                # The churn denominator: the PRIMARY plan's move count
-                # is the minimum a perfect run would execute; recovery
-                # rounds only ever add to the numerator.
-                o.visit_next_moves(lambda m: slo.set_min_moves(
-                    sum(len(nm.moves) for nm in m.values())))
-            slo.attach_health(o.health)
-            async for progress in o.progress_ch():
-                events += 1
-                final = progress
-                if on_progress is not None:
-                    on_progress(progress)
-            o.stop()
+            events = 0
+            orch_phase = "orchestrate" if round_i == 0 \
+                else f"recovery_orchestrate_{round_i}"
+            with timer.phase(orch_phase):
+                round_opts = opts
+                if ft and health is not None:
+                    # Quarantine state carries across rounds: a node that
+                    # tripped in round k stays dark in round k+1 unless its
+                    # half-open probe heals it.
+                    round_opts = dataclasses.replace(opts, health=health)
+                orch_nodes = [n for n in nodes_all if n not in quarantined]
+                o = orchestrate_moves(
+                    model,
+                    round_opts,
+                    orch_nodes,
+                    beg,
+                    next_map,
+                    assign_partitions,
+                    find_move or lowest_weight_partition_move_for_node,
+                    move_observers=(slo,),
+                )
+                if round_i == 0:
+                    # The churn denominator: the PRIMARY plan's move count
+                    # is the minimum a perfect run would execute; recovery
+                    # rounds only ever add to the numerator.
+                    o.visit_next_moves(lambda m: slo.set_min_moves(
+                        sum(len(nm.moves) for nm in m.values())))
+                slo.attach_health(o.health)
+                async for progress in o.progress_ch():
+                    events += 1
+                    final = progress
+                    if on_progress is not None:
+                        on_progress(progress)
+                o.stop()
 
-        events_total += events
-        round_failures = o.move_failures()
-        all_failures.extend(round_failures)
-        health = o.health
-        quarantined = health.quarantined_nodes() if health is not None \
-            else []
-        rounds.append(RecoveryRound(
-            round=round_i, dead_nodes=list(quarantined),
-            failures=len(round_failures), progress_events=events,
-            progress=final))
-        if ft:
-            achieved = _strip_nodes(o.achieved_map(), set(quarantined))
-            # Mirror the presumption on the live SLO view: a quarantined
-            # node's placements are lost, so availability drops NOW, not
-            # after the recovery round re-places them.
-            slo.strip_nodes(set(quarantined))
+            events_total += events
+            round_failures = o.move_failures()
+            all_failures.extend(round_failures)
+            health = o.health
+            quarantined = health.quarantined_nodes() if health is not None \
+                else []
+            rounds.append(RecoveryRound(
+                round=round_i, dead_nodes=list(quarantined),
+                failures=len(round_failures), progress_events=events,
+                progress=final))
+            if ft:
+                achieved = _strip_nodes(o.achieved_map(), set(quarantined))
+                # Mirror the presumption on the live SLO view: a quarantined
+                # node's placements are lost, so availability drops NOW, not
+                # after the recovery round re-places them.
+                slo.strip_nodes(set(quarantined))
 
-        if not ft or not round_failures:
-            # Converged (or legacy mode, which never recovers): a
-            # quarantined node with zero failures this round means the
-            # plan already routed around it.  With a session, a clean
-            # pass adopts the proposal so the next plan — this
-            # rebalance's or a later one — warm-starts off the carry.
-            if session is not None and not round_failures and \
-                    not final.errors:
-                session.apply()
-            break
-        if round_i >= max_recovery_rounds:
-            break
+            if not ft or not round_failures:
+                # Converged (or legacy mode, which never recovers): a
+                # quarantined node with zero failures this round means the
+                # plan already routed around it.  With a session, a clean
+                # pass adopts the proposal so the next plan — this
+                # rebalance's or a later one — warm-starts off the carry.
+                if session is not None and not round_failures and \
+                        not final.errors:
+                    session.apply()
+                break
+            if round_i >= max_recovery_rounds:
+                break
 
-        # -- set up the recovery round ------------------------------------
-        rec.count("rebalance.recovery_rounds")
-        if session is not None:
-            # Warm fast path: failures confined to the dead nodes mean
-            # the achieved state differs from the adopted proposal only
-            # on rows that held a dead-node copy — exactly the rows
-            # remove_nodes(dead) marks dirty, so the carry stays sound.
-            confined = bool(quarantined) and all(
-                f.node in set(quarantined) for f in round_failures)
-            if confined:
-                session.apply()
-                warm_ok = True
-            else:
-                warm_ok = False
-        beg = achieved
-        # The original removal intent persists until drained: a node the
-        # caller was decommissioning must not be re-adopted just because
-        # a failed round left copies on it.  Quarantined nodes join it.
-        removes = sorted(set(removes) | set(quarantined))
-        adds = []
+            # -- set up the recovery round ------------------------------------
+            rec.count("rebalance.recovery_rounds")
+            if session is not None:
+                # Warm fast path: failures confined to the dead nodes mean
+                # the achieved state differs from the adopted proposal only
+                # on rows that held a dead-node copy — exactly the rows
+                # remove_nodes(dead) marks dirty, so the carry stays sound.
+                confined = bool(quarantined) and all(
+                    f.node in set(quarantined) for f in round_failures)
+                if confined:
+                    session.apply()
+                    warm_ok = True
+                else:
+                    warm_ok = False
+            beg = achieved
+            # The original removal intent persists until drained: a node the
+            # caller was decommissioning must not be re-adopted just because
+            # a failed round left copies on it.  Quarantined nodes join it.
+            removes = sorted(set(removes) | set(quarantined))
+            adds = []
 
-    # Recovery exhaustion is DATA, not silence: a run that still has
-    # failures outstanding after its last round (or that degraded to an
-    # empty placement) is not converged, and the residual summary says
-    # what is still broken — a partial map must never be
-    # indistinguishable from success.
-    residual: dict[str, int] = {}
-    converged = True
-    if ft and (round_failures or degraded is not None):
-        converged = False
-        for f in round_failures:
-            residual[f.node] = residual.get(f.node, 0) + 1
-        rec.count("rebalance.unconverged")
+        # Recovery exhaustion is DATA, not silence: a run that still has
+        # failures outstanding after its last round (or that degraded to an
+        # empty placement) is not converged, and the residual summary says
+        # what is still broken — a partial map must never be
+        # indistinguishable from success.
+        residual: dict[str, int] = {}
+        converged = True
+        if ft and (round_failures or degraded is not None):
+            converged = False
+            for f in round_failures:
+                residual[f.node] = residual.get(f.node, 0) + 1
+            rec.count("rebalance.unconverged")
 
-    slo.publish()
-    return RebalanceResult(
-        next_map=next_map,
-        warnings=all_warnings,
-        progress=final,
-        progress_events=events_total,
-        timer=timer,
-        failures=all_failures,
-        rounds=rounds,
-        achieved_map=achieved,
-        quarantined_nodes=list(quarantined),
-        slo=slo.summary(),
-        converged=converged,
-        residual_failures=residual,
-        degraded=degraded,
-    )
+        slo.close_incident()
+        slo.publish()
+        return RebalanceResult(
+            next_map=next_map,
+            warnings=all_warnings,
+            progress=final,
+            progress_events=events_total,
+            timer=timer,
+            failures=all_failures,
+            rounds=rounds,
+            achieved_map=achieved,
+            quarantined_nodes=list(quarantined),
+            slo=slo.summary(),
+            converged=converged,
+            residual_failures=residual,
+            degraded=degraded,
+        )
+    except BaseException:
+        # A raise out of the episode is not an incident with a
+        # makespan: a reused tracker must not carry a stale open
+        # incident into its next rebalance call.
+        slo.discard_incident()
+        raise
 
 
 def rebalance(*args, **kwargs) -> RebalanceResult:
@@ -643,6 +657,11 @@ class RebalanceController:
         progress callbacks."""
         self._pending.append(delta)
         self._rec.count("sim.deltas")
+        if self._slo is not None:
+            # One busy episode = one SLO incident (first submit wins;
+            # the next quiesce closes it with the time-to-last-required
+            # -move sample, slo.first_converged_lag_s).
+            self._slo.open_incident(self._rec.now())
         self._idle.clear()
         self._wake.set()
 
@@ -716,6 +735,12 @@ class RebalanceController:
                     self.cycles += 1
                     await self._converge()
         finally:
+            if self._slo is not None and not self._idle.is_set():
+                # A crash / mid-episode stop is not a quiesce: the open
+                # incident dies unrecorded (same discard-on-raise rule
+                # as rebalance_async) instead of closing as an
+                # "instantly converged" 0.0 lag sample.
+                self._slo.discard_incident()
             self._set_idle()
 
     def _take_pending(self) -> list[ClusterDelta]:
@@ -727,6 +752,8 @@ class RebalanceController:
         if not self._idle.is_set():
             self._idle.set()
             t = self._rec.now()
+            if self._slo is not None:
+                self._slo.close_incident(t)
             for hook in self.on_quiesce:
                 hook(t)
 

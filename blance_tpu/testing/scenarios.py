@@ -38,6 +38,7 @@ __all__ = [
     "spot_preemption",
     "zone_flap",
     "weight_drift",
+    "hetero_drain",
     "mixed_week",
     "SCENARIOS",
 ]
@@ -88,6 +89,16 @@ class SimScenario:
     use_session: bool = False
     backend: str = "greedy"
     max_steps: int = 4_000_000
+    # Moves fed per node per batch (OrchestratorOptions.
+    # max_concurrent_partition_moves_per_node — the scheduler's lane
+    # count per machine).
+    max_concurrent_moves: int = 1
+    # Move-ordering policy: "legacy" (the reference app-weight order)
+    # or "critical_path" (orchestrate/sched.CriticalPathScheduler on a
+    # prior-seeded CostModel learning online from the run's own spans).
+    # Same deltas, same planner, same move SET either way — only the
+    # order and the clock differ (docs/SCHEDULER.md).
+    scheduler: str = "legacy"
 
 
 def scenario_model(scn: SimScenario) -> PartitionModel:
@@ -214,6 +225,53 @@ def weight_drift(seed: int = 37) -> SimScenario:
         events=tuple(events), availability_floor=0.999)
 
 
+def hetero_drain(seed: int = 41) -> SimScenario:
+    """Heterogeneous mover latencies with ONE slow node, drained into
+    capacity joins: the critical-path scheduling showcase (ISSUE 12).
+
+    Every join pulls a near-uniform slice of placements onto the empty
+    joiner — chains of ``[add(joiner), del(source)]`` whose level-0
+    adds all CONTEND for the joiner's single lane while the del tails
+    cost whatever their source node costs.  The makespan is therefore
+    decided by WHEN the slow node's del chains start: app-weight order
+    is blind to the tails (every add weighs 3, ties break on partition
+    name), so the slow chain's add lands anywhere in the joiner's
+    serial queue; critical-path order feeds the highest-upward-rank
+    (slowest-tail) chains first.  The first join doubles as the cost
+    model's calibration pass (every node executes a del, teaching its
+    latency); the two joins after it are the measured incidents.  No
+    faults: both orders execute the identical move set, so churn is
+    exactly equal and only the clock differs."""
+    rng = random.Random(f"hetero:{seed}")
+    nodes = tuple(f"n{i}" for i in range(12))
+    lat: dict[str, float] = {
+        n: round(rng.choice([0.5, 1.0, 1.5, 2.0]), 3) for n in nodes}
+    # One badly slow mover plus two laggards: the del tails the
+    # critical path must order longest-first (LPT) off the joiner.
+    lat[nodes[-1]] = 16.0
+    lat[nodes[-2]] = 12.0
+    lat[nodes[-3]] = 9.0
+    for joiner in ("w0", "r0", "r1"):
+        lat[joiner] = 1.0
+    events = (
+        SimEvent(t=_jitter(rng, 120, 10),
+                 delta=ClusterDelta(add=("w0",)),
+                 label="warmup-join-w0"),
+        SimEvent(t=_jitter(rng, 1200, 30),
+                 delta=ClusterDelta(add=("r0",)),
+                 label="join-r0"),
+        SimEvent(t=_jitter(rng, 2400, 30),
+                 delta=ClusterDelta(add=("r1",)),
+                 label="join-r1"),
+    )
+    return SimScenario(
+        name="hetero_drain", seed=seed, horizon_s=3600.0,
+        nodes=nodes, partitions=96, replicas=1, events=events,
+        availability_floor=0.999, base_latency_s=1.0,
+        node_latency_s=lat, max_retries=0, quarantine_after=0,
+        max_concurrent_moves=1)
+
+
 def mixed_week(seed: int = 7, days: float = 7.0) -> SimScenario:
     """The long-horizon soak: ``days`` of virtual cluster life mixing
     every fault family — daily join/decommission churn, two spot
@@ -303,5 +361,6 @@ SCENARIOS: dict[str, Callable[[int], SimScenario]] = {
     "spot_preemption": spot_preemption,
     "zone_flap": zone_flap,
     "weight_drift": weight_drift,
+    "hetero_drain": hetero_drain,
     "mixed_week": mixed_week,
 }

@@ -178,15 +178,34 @@ async def _sim_main(scn: SimScenario, loop: DeterministicLoop,
                                  sorted(beg.keys()))
         session.load_map(beg)
 
+    orch_opts = OrchestratorOptions(
+        move_timeout_s=scn.move_timeout_s,
+        max_retries=scn.max_retries,
+        backoff_base_s=scn.backoff_base_s,
+        retry_seed=scn.seed,
+        quarantine_after=scn.quarantine_after,
+        probe_after_s=scn.probe_after_s,
+        max_concurrent_partition_moves_per_node=scn.max_concurrent_moves)
+    if scn.scheduler == "critical_path":
+        # Critical-path move order (docs/SCHEDULER.md): the cost model
+        # seeds from the committed bench priors and recalibrates ONLINE
+        # from this very run's move spans (virtual-time durations, so
+        # the whole account replays bit-identically); each controller
+        # pass re-binds the policy against its fresh move plans.
+        from ..obs.costmodel import CostModel, default_op_priors
+        from ..orchestrate.sched import CriticalPathScheduler
+
+        cost_model = CostModel(recorder=rec)
+        cost_model.seed_priors(default_op_priors())
+        rec.add_sink(cost_model)
+        orch_opts.scheduler = CriticalPathScheduler(cost_model=cost_model)
+    elif scn.scheduler != "legacy":
+        raise ValueError(f"unknown scheduler {scn.scheduler!r} "
+                         f"(want 'legacy' or 'critical_path')")
+
     ctl = RebalanceController(
         model, list(scn.nodes), beg, fault_plan.wrap(data_plane),
-        orchestrator_options=OrchestratorOptions(
-            move_timeout_s=scn.move_timeout_s,
-            max_retries=scn.max_retries,
-            backoff_base_s=scn.backoff_base_s,
-            retry_seed=scn.seed,
-            quarantine_after=scn.quarantine_after,
-            probe_after_s=scn.probe_after_s),
+        orchestrator_options=orch_opts,
         backend=scn.backend, session=session,
         debounce_s=scn.debounce_s,
         max_passes_per_cycle=scn.max_passes_per_cycle,

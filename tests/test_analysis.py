@@ -592,6 +592,7 @@ def test_race_lint_real_package_model_matches_reality():
     import blance_tpu.orchestrate.orchestrator as orch
     import importlib
 
+    import blance_tpu.orchestrate.sched.policy as schedpolicy
     import blance_tpu.plan.carry as plancarry
     import blance_tpu.plan.service as planservice
     from blance_tpu.analysis.race_lint import SHARED_STATE
@@ -616,6 +617,8 @@ def test_race_lint_real_package_model_matches_reality():
         "CarryCache": inspect.getsource(plancarry.CarryCache),
         "RebalanceController": inspect.getsource(
             rebalance.RebalanceController),
+        "_CriticalPathBound": inspect.getsource(
+            schedpolicy._CriticalPathBound),
     }
     for cls, attrs in SHARED_STATE.items():
         src = sources[cls]

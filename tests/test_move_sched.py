@@ -1,0 +1,671 @@
+"""Critical-path move scheduler (orchestrate/sched/, docs/SCHEDULER.md).
+
+Covers the move-DAG builder (chain slicing, lifecycle validation,
+machine model), the upward-rank sweep (host values, host/device parity,
+engine counters), HEFT-style list scheduling (precedence, lane capacity,
+stalled chains, determinism), the orchestrator binding (legacy default
+extraction, mutual exclusion with a custom find_move, sched.* metrics,
+online reschedule on quarantine), the identity contract — scheduled
+execution produces the bit-identical final map and move SET as the
+legacy app-weight order, cold, warm (session-backed) and under chaos —
+plus the cost-model cold-start priors and the SloTracker per-incident
+makespan satellites (ISSUE 12)."""
+
+import asyncio
+import json
+import types
+
+import pytest
+
+from blance_tpu import Partition, PartitionModelState, model
+from blance_tpu.obs import Recorder, use_recorder
+from blance_tpu.obs.costmodel import CostModel, default_op_priors
+from blance_tpu.obs.slo import SloTracker
+from blance_tpu.orchestrate import FaultPlan, NodeFaults
+from blance_tpu.orchestrate.orchestrator import (
+    OrchestratorOptions,
+    lowest_weight_partition_move_for_node,
+    orchestrate_moves,
+)
+from blance_tpu.orchestrate.sched import (
+    CriticalPathScheduler,
+    LegacyWeightOrder,
+    MoveDagError,
+    build_move_dag,
+    list_schedule,
+    upward_ranks,
+)
+from blance_tpu.orchestrate.sched.policy import (
+    _LEGACY_BOUND,
+    _CriticalPathBound,
+)
+from blance_tpu.rebalance import (
+    ClusterDelta,
+    RebalanceController,
+    rebalance_async,
+)
+
+MR_MODEL = {
+    "primary": PartitionModelState(priority=0, constraints=0),
+    "replica": PartitionModelState(priority=0, constraints=1),
+}
+
+
+def pm(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+def mv(node, state="primary", op="add"):
+    return types.SimpleNamespace(node=node, state=state, op=op)
+
+
+def cursor(partition, moves, next=0, failed_at=None):
+    return types.SimpleNamespace(partition=partition, moves=moves,
+                                 next=next, failed_at=failed_at)
+
+
+# -- the move-DAG builder -----------------------------------------------------
+
+
+def test_dag_chains_levels_machines():
+    cursors = {
+        "p0": cursor("p0", [mv("b", op="add"), mv("a", "", op="del")]),
+        "p1": cursor("p1", [mv("c", op="add")]),
+    }
+    dag = build_move_dag(cursors, nodes_all=["a", "b", "c"],
+                         max_concurrent=2)
+    assert set(dag.chains) == {"p0", "p1"}
+    assert [m.op for m in dag.chains["p0"]] == ["add", "del"]
+    assert [m.level for m in dag.chains["p0"]] == [0, 1]
+    # The chain's indices are ABSOLUTE move-list coordinates.
+    assert [m.index for m in dag.chains["p0"]] == [0, 1]
+    # levels[k] holds every chain's k-th remaining move.
+    assert {m.partition for m in dag.levels[0]} == {"p0", "p1"}
+    assert [m.partition for m in dag.levels[1]] == ["p0"]
+    assert dag.machines == {"a": 2, "b": 2, "c": 2}
+    # predecessor() walks the chain edge.
+    assert dag.predecessor(dag.chains["p0"][1]) == dag.chains["p0"][0]
+    assert dag.predecessor(dag.chains["p0"][0]) is None
+
+
+def test_dag_slices_from_cursor_and_skips_abandoned():
+    cursors = {
+        "done": cursor("done", [mv("a")], next=1),
+        "mid": cursor("mid", [mv("a"), mv("b"), mv("c")], next=1),
+        "dead": cursor("dead", [mv("a"), mv("b")], failed_at=0),
+    }
+    dag = build_move_dag(cursors, nodes_all=["a", "b", "c"])
+    # Finished and abandoned partitions contribute nothing; the live
+    # chain starts AT the cursor with absolute indices preserved.
+    assert set(dag.chains) == {"mid"}
+    assert [(m.index, m.level, m.node) for m in dag.chains["mid"]] == \
+        [(1, 0, "b"), (2, 1, "c")]
+
+
+def test_dag_validates_nothing_after_del():
+    cursors = {"p": cursor(
+        "p", [mv("a", "", op="del"), mv("a", op="promote")])}
+    with pytest.raises(MoveDagError, match="after its removal"):
+        build_move_dag(cursors, nodes_all=["a"])
+
+
+def test_dag_validates_add_before_use():
+    cursors = {"p": cursor(
+        "p", [mv("b", op="promote"), mv("b", op="add")])}
+    with pytest.raises(MoveDagError, match="make before"):
+        build_move_dag(cursors, nodes_all=["b"])
+
+
+def test_dag_accepts_reference_lifecycle():
+    cursors = {"p": cursor("p", [
+        mv("b", "replica", op="add"), mv("b", "primary", op="promote"),
+        mv("a", "replica", op="demote"), mv("a", "", op="del")])}
+    dag = build_move_dag(cursors, nodes_all=["a", "b"])
+    assert len(dag.chains["p"]) == 4
+
+
+# -- upward ranks -------------------------------------------------------------
+
+
+def test_upward_ranks_are_suffix_sums():
+    ranks = upward_ranks([[1.0, 2.0, 3.0], [5.0], []])
+    assert ranks == [[6.0, 5.0, 3.0], [5.0], []]
+
+
+def test_upward_ranks_host_device_parity():
+    pytest.importorskip("jax")
+    chain_costs = [[0.125 * (i + j + 1) for j in range(1 + i % 4)]
+                   for i in range(12)]
+    rec = Recorder()
+    host = upward_ranks(chain_costs, device_threshold=10**9, recorder=rec)
+    dev = upward_ranks(chain_costs, device_threshold=0, recorder=rec)
+    assert rec.counters["sched.host_ranks"] == 1
+    assert rec.counters["sched.device_ranks"] == 1
+    for h, d in zip(host, dev):
+        assert len(h) == len(d)
+        for a, b in zip(h, d):
+            assert abs(a - b) < 1e-5  # float32 device sweep vs host
+
+
+# -- HEFT-style list scheduling ----------------------------------------------
+
+
+def _plan(cursors, nodes, lanes=1):
+    dag = build_move_dag(cursors, nodes_all=nodes, max_concurrent=lanes)
+    chains = list(dag.chains.values())
+    costs = {}
+    ranks = {}
+    for chain, cranks in zip(
+            chains, upward_ranks([[1.0] * len(c) for c in chains])):
+        for m, r in zip(chain, cranks):
+            costs[(m.partition, m.index)] = 1.0
+            ranks[(m.partition, m.index)] = r
+    return dag, list_schedule(dag, costs, ranks)
+
+
+def test_list_schedule_respects_precedence_and_lanes():
+    cursors = {
+        f"p{i}": cursor(f"p{i}", [mv("j", op="add"), mv("a", "", op="del")])
+        for i in range(4)}
+    dag, plan = _plan(cursors, ["a", "j"], lanes=1)
+    assert plan.scheduled_keys() == {(m.partition, m.index)
+                                     for m in dag.moves()}
+    assert plan.stalled == ()
+    by_key = {(m.partition, m.index): m for m in plan.moves}
+    for p in cursors:
+        add, dele = by_key[(p, 0)], by_key[(p, 1)]
+        assert dele.start_s >= add.finish_s  # chain edge honored
+    # One joiner lane: its adds serialize; makespan covers them plus a
+    # trailing del.
+    assert plan.makespan_s == 5.0
+    assert plan.critical_path_s == 2.0
+    assert 0.0 < plan.lane_utilization <= 1.0
+
+
+def test_list_schedule_stalls_machineless_chains():
+    cursors = {
+        "ok": cursor("ok", [mv("a", op="add")]),
+        "stuck": cursor("stuck", [mv("q", op="add"),
+                                  mv("a", "", op="del")]),
+    }
+    _dag, plan = _plan(cursors, ["a"])  # "q" has no machine
+    assert plan.scheduled_keys() == {("ok", 0)}
+    # The machineless move AND its chain successor both stall — every
+    # remaining move appears exactly once across moves+stalled.
+    assert set(plan.stalled) == {("stuck", 0), ("stuck", 1)}
+    # A stalled chain's tail must not inflate the critical path past
+    # the predicted makespan — the gauge is a makespan LOWER bound.
+    assert plan.critical_path_s <= plan.makespan_s
+
+
+def test_list_schedule_is_deterministic():
+    cursors = {f"p{i}": cursor(f"p{i}", [mv("n", op="add")])
+               for i in range(6)}
+    _dag, a = _plan(cursors, ["n"], lanes=2)
+    _dag, b = _plan(cursors, ["n"], lanes=2)
+    assert a == b
+
+
+# -- orchestrator binding -----------------------------------------------------
+
+
+def _run_orchestration(make):
+    """Build the orchestrator INSIDE the running loop (it spawns its
+    supplier/mover tasks at construction), drain it, hand it back."""
+    async def go():
+        o = make()
+        async for _ in o.progress_ch():
+            pass
+        o.stop()
+        return o
+    return asyncio.run(go())
+
+
+def test_default_options_bind_the_legacy_policy():
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    o = _run_orchestration(lambda: orchestrate_moves(
+        MR_MODEL, OrchestratorOptions(), ["a", "b"],
+        pm({"00": {"primary": ["a"]}}), pm({"00": {"primary": ["b"]}}),
+        assign))
+    assert o.sched is _LEGACY_BOUND
+
+
+def test_legacy_bound_selects_like_the_weight_rule():
+    cands = [cursor("x", [mv("n", op="del")]),
+             cursor("y", [mv("n", op="promote")]),
+             cursor("z", [mv("n", op="add")])]
+    assert _LEGACY_BOUND.select("n", cands) == 1
+    assert LegacyWeightOrder().bind([], {}, 1, Recorder()) is _LEGACY_BOUND
+    # And the module-level rule is still importable from the orchestrator
+    # (the extraction is a move, not an API break).
+    moves = [c.moves[0] for c in cands]
+    assert lowest_weight_partition_move_for_node("n", moves) == 1
+
+
+def test_scheduler_and_custom_find_move_are_mutually_exclusive():
+    async def go():
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            orchestrate_moves(
+                MR_MODEL,
+                OrchestratorOptions(scheduler=CriticalPathScheduler()),
+                ["a", "b"],
+                pm({"00": {"primary": ["a"]}}),
+                pm({"00": {"primary": ["b"]}}),
+                lambda *a: None,
+                lambda node, moves: 0)
+    asyncio.run(go())
+
+
+def test_scheduled_run_publishes_sched_metrics():
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    rec = Recorder()
+    with use_recorder(rec):
+        o = _run_orchestration(lambda: orchestrate_moves(
+            MR_MODEL,
+            OrchestratorOptions(scheduler=CriticalPathScheduler()),
+            ["a", "b", "c"],
+            pm({f"p{i}": {"primary": ["a"]} for i in range(4)}),
+            pm({f"p{i}": {"primary": ["b" if i % 2 else "c"]}
+                for i in range(4)}),
+            assign))
+    assert isinstance(o.sched, _CriticalPathBound)
+    plan = o.sched.plan
+    assert plan.makespan_s > 0.0
+    assert plan.critical_path_s > 0.0
+    # 4 adds + 4 dels, none stalled — no quarantine happened, so the
+    # bound still holds the initial build's plan.
+    assert len(plan.moves) == 8 and plan.stalled == ()
+    assert len(plan.moves) == len(o.sched.last_remaining)
+    assert rec.gauges["sched.makespan_predicted_s"] > 0.0
+    assert rec.gauges["sched.critical_path_s"] > 0.0
+    assert 0.0 < rec.gauges["sched.lane_utilization"] <= 1.0
+    assert "sched.makespan_actual_s" in rec.gauges
+    assert rec.histograms.get("sched.makespan_rel_err")
+    # Priors-only model: every prediction was a cold fallback.
+    assert rec.counters["costmodel.cold_predictions"] >= 8
+
+
+def test_truncated_run_is_not_scored():
+    """finish() on a cancelled/superseded orchestration (live moves
+    still pending) must NOT record makespan_actual_s or a rel-err
+    sample — a supersede 1s into a 100s plan is not 99x prediction
+    error, and mixed_week's overlapping supersedes would otherwise
+    drown the histogram in truncation noise."""
+    rec = Recorder()
+    cursors = {"p0": cursor("p0", [mv("b", op="add"),
+                                   mv("a", "", op="del")])}
+    bound = CriticalPathScheduler().bind(["a", "b"], cursors, 1, rec)
+    assert bound.plan.makespan_s > 0.0
+    bound.finish(rec.now())  # cursor still at 0: truncated wind-down
+    assert "sched.makespan_actual_s" not in rec.gauges
+    assert not rec.histograms.get("sched.makespan_rel_err")
+    # The same wind-down with the chain complete DOES score.
+    rec2 = Recorder()
+    done = cursor("p0", [mv("b", op="add")])
+    done.next = 1
+    bound2 = CriticalPathScheduler().bind(["a", "b"], {"p0": done},
+                                          1, rec2)
+    bound2.on_batch("b", [], ok=True, now=rec2.now())
+    bound2.finish(rec2.now())
+    assert "sched.makespan_actual_s" in rec2.gauges
+
+
+def test_quarantine_triggers_online_reschedule():
+    plan = FaultPlan(seed=9, nodes={"dead": NodeFaults(dead=True)})
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    rec = Recorder()
+    with use_recorder(rec):
+        o = _run_orchestration(lambda: orchestrate_moves(
+            MR_MODEL,
+            OrchestratorOptions(
+                scheduler=CriticalPathScheduler(), move_timeout_s=0.25,
+                max_retries=0, quarantine_after=1, probe_after_s=600.0),
+            ["a", "b", "dead"],
+            pm({"p0": {"primary": ["a"]}, "p1": {"primary": ["a"]}}),
+            pm({"p0": {"primary": ["dead"]}, "p1": {"primary": ["b"]}}),
+            plan.wrap(assign)))
+        bound = o.sched
+    assert bound.reschedules >= 1
+    assert "dead" in bound.quarantined()
+    assert rec.counters["sched.reschedules"] == bound.reschedules
+    # Post-reschedule plan: nothing sits on the quarantined node's lanes.
+    assert all(m.node != "dead" for m in bound.plan.moves)
+
+
+def test_heal_restores_lanes_and_reschedules():
+    """A half-open probe heal must rebuild the schedule with the
+    node's lanes back in the machine model — a heal-blind plan would
+    keep the healed node's chains 'stalled' (and the makespan gauges
+    wrong) for the rest of the run."""
+    # Attempt 1 on "flaky" faults (tripping the quarantine_after=1
+    # breaker); the probe is due immediately (probe_after_s=0) and
+    # heal_after=1 makes it succeed — the heal transition mid-run.
+    plan = FaultPlan(seed=9, nodes={"flaky": NodeFaults(dead=True,
+                                                        heal_after=1)})
+
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    rec = Recorder()
+    with use_recorder(rec):
+        o = _run_orchestration(lambda: orchestrate_moves(
+            MR_MODEL,
+            OrchestratorOptions(
+                scheduler=CriticalPathScheduler(), move_timeout_s=0.25,
+                max_retries=0, quarantine_after=1, probe_after_s=0.0),
+            ["a", "b", "flaky"],
+            pm({f"p{i}": {"primary": ["a"]} for i in range(4)}),
+            pm({f"p{i}": {"primary": ["flaky"]} for i in range(4)}),
+            plan.wrap(assign)))
+        bound = o.sched
+    # Trip then heal: two rebuilds, and the healed node is out of the
+    # bound's quarantine set (its lanes rejoined the machine model).
+    assert bound.reschedules >= 2
+    assert "flaky" not in bound.quarantined()
+    # Only the tripping partition was sacrificed; the rest flowed onto
+    # the healed node after the probe re-admitted it.
+    assert len({f.partition for f in o.failures}) <= 1
+    assert o._progress.tot_mover_assign_partition_ok > 0
+
+
+# -- the identity contract: same map, same move set, only the clock ----------
+
+
+def _hetero_assign(recs):
+    async def assign(stop_ch, node, partitions, states, ops):
+        recs.append((partitions[0], node, states[0], ops[0]))
+        await asyncio.sleep(0)
+    return assign
+
+
+def _scheduler_for(kind):
+    return None if kind == "legacy" else CriticalPathScheduler()
+
+
+@pytest.mark.parametrize("chaos", [False, True],
+                         ids=["cold", "chaos"])
+def test_final_map_and_move_set_identical_to_legacy(chaos):
+    """The scheduler chooses ORDER only: the rebalance result (final
+    map, convergence, residuals) and the executed move SET must be
+    bit-identical to the legacy app-weight order — with and without a
+    dead node tripping the breaker mid-run."""
+    m = model(primary=(0, 1), replica=(1, 1))
+    nodes = ["a", "b", "c", "d"]
+    beg = pm({f"p{i}": {"primary": [nodes[i % 3]],
+                        "replica": [nodes[(i + 1) % 3]]}
+              for i in range(9)})
+
+    def run_one(kind):
+        recs = []
+        faults = FaultPlan(
+            seed=13, nodes={"c": NodeFaults(dead=True)} if chaos else {})
+        opts = OrchestratorOptions(
+            scheduler=_scheduler_for(kind), move_timeout_s=0.25,
+            max_retries=0, quarantine_after=1, probe_after_s=600.0)
+        r = asyncio.run(rebalance_async(
+            m, beg, nodes, ["a"], [], faults.wrap(_hetero_assign(recs)),
+            orchestrator_options=opts, max_recovery_rounds=2,
+            backend="greedy"))
+        return r, recs
+
+    r_leg, recs_leg = run_one("legacy")
+    r_crit, recs_crit = run_one("critical_path")
+    assert {k: v.nodes_by_state for k, v in r_leg.next_map.items()} == \
+        {k: v.nodes_by_state for k, v in r_crit.next_map.items()}
+    assert r_leg.converged == r_crit.converged
+    assert r_leg.residual_failures == r_crit.residual_failures
+    # Same move SET (the order legitimately differs).
+    assert sorted(recs_leg) == sorted(recs_crit)
+    if not chaos:
+        assert r_leg.converged
+
+
+def test_session_backed_controller_identical_final_map():
+    """Warm path: a session-backed controller (warm carry across
+    cycles) lands on the identical final map whether its orchestrations
+    run legacy or critical-path order."""
+    pytest.importorskip("jax")
+    from blance_tpu.plan.session import PlannerSession
+
+    def drive(kind):
+        async def go():
+            m = model(primary=(0, 1))
+            nodes = ["a", "b", "c"]
+            parts = [f"p{i}" for i in range(8)]
+            cur = pm({p: {"primary": [nodes[i % 3]]}
+                      for i, p in enumerate(parts)})
+            session = PlannerSession(m, nodes, parts)
+            session.load_map(cur)
+            recs = []
+            ctl = RebalanceController(
+                m, nodes, cur, _hetero_assign(recs), session=session,
+                debounce_s=0.001,
+                orchestrator_options=OrchestratorOptions(
+                    scheduler=_scheduler_for(kind)))
+            ctl.start()
+            ctl.submit(ClusterDelta(remove=("a",)))
+            await asyncio.wait_for(ctl.quiesce(), 30)
+            ctl.submit(ClusterDelta(add=("a",)))
+            final = await asyncio.wait_for(ctl.quiesce(), 30)
+            await ctl.stop()
+            return final, recs
+        return asyncio.run(go())
+
+    final_leg, recs_leg = drive("legacy")
+    final_crit, recs_crit = drive("critical_path")
+    assert {k: v.nodes_by_state for k, v in final_leg.items()} == \
+        {k: v.nodes_by_state for k, v in final_crit.items()}
+    assert sorted(recs_leg) == sorted(recs_crit)
+
+
+# -- cost-model cold-start priors ---------------------------------------------
+
+
+def test_committed_priors_load_and_are_non_uniform():
+    priors = default_op_priors()
+    assert set(priors) == {"add", "del", "promote", "demote"}
+    assert all(s > 0.0 for s in priors.values())
+    # The committed calibration prices a del cheaper than an add — the
+    # non-uniformity the scheduler needs on a fresh cluster.
+    assert priors["del"] < priors["add"]
+
+
+def test_priors_version_mismatch_raises(tmp_path):
+    p = tmp_path / "stale.json"
+    p.write_text(json.dumps({"version": 0, "op_priors_s": {"add": 1.0}}))
+    with pytest.raises(ValueError, match="priors version"):
+        default_op_priors(str(p))
+
+
+def test_seed_priors_never_overwrites_learned_estimates():
+    rec = Recorder()
+    cm = CostModel(recorder=rec)
+    cm.seed_priors({"add": 5.0})
+    assert cm.predict("anywhere", "add") == 5.0
+    # An op aggregate learned from real observations survives a reseed.
+    cm._op_est["add"] = [0.25, 4]
+    cm.seed_priors({"add": 5.0})
+    assert cm.predict("anywhere", "add") == 0.25
+
+
+def test_cold_predictions_counter_and_with_priors():
+    rec = Recorder()
+    cm = CostModel.with_priors(recorder=rec)
+    a = cm.predict("fresh-node", "add")
+    d = cm.predict("fresh-node", "del")
+    assert a != d  # priors, not the flat default
+    assert rec.counters["costmodel.cold_predictions"] == 2
+    # An exact (node, op) estimate is NOT a cold prediction.
+    cm._est[("fresh-node", "add")] = [0.5, 3]
+    assert cm.predict("fresh-node", "add") == 0.5
+    assert rec.counters["costmodel.cold_predictions"] == 2
+
+
+def test_predict_move_uses_priors():
+    cm = CostModel.with_priors()
+    priors = default_op_priors()
+    assert cm.predict_move(mv("nowhere", op="add")) == priors["add"]
+
+
+# -- SloTracker per-incident makespan ----------------------------------------
+
+
+class _Mv:
+    def __init__(self, partition, node, state="primary", op="add"):
+        self.partition, self.node = partition, node
+        self.state, self.op = state, op
+
+
+def test_incident_lag_measures_to_last_executed_move():
+    t = {"now": 0.0}
+    rec = Recorder(clock=lambda: t["now"])
+    slo = SloTracker(pm({"p0": {"primary": ["a"]}}),
+                     clock=lambda: t["now"], recorder=rec)
+    slo.open_incident()
+    t["now"] = 3.0
+    slo.on_batch("b", [_Mv("p0", "b")], ok=True, now=3.0)
+    # A long idle tail after the last move (debounce, planner time)
+    # must NOT inflate the makespan sample.
+    t["now"] = 60.0
+    assert slo.close_incident() == 3.0
+    assert slo.first_converged_lags() == [3.0]
+    assert rec.gauges["slo.first_converged_lag_s"] == 3.0
+    assert slo.summary().first_converged_lag_s == 3.0
+
+
+def test_incident_open_is_first_wins_and_zero_move_incidents_are_zero():
+    t = {"now": 10.0}
+    slo = SloTracker(pm({"p0": {"primary": ["a"]}}),
+                     clock=lambda: t["now"])
+    assert slo.close_incident() is None  # nothing open
+    slo.open_incident()
+    t["now"] = 25.0
+    slo.open_incident()  # a coalesced burst: the FIRST event anchors
+    t["now"] = 30.0
+    slo.on_batch("b", [_Mv("p0", "b")], ok=True, now=30.0)
+    assert slo.close_incident() == 20.0
+    # An incident that needed no moves converged instantly.
+    slo.open_incident()
+    assert slo.close_incident() == 0.0
+    assert slo.first_converged_lags() == [20.0, 0.0]
+
+
+def test_incident_with_only_failures_reports_the_whole_window():
+    # An incident whose moves all FAILED never converged: its lag is
+    # the open-to-close window (a lower bound), never a 0.0 that would
+    # deflate the makespan p95 with "instant" unconverged incidents.
+    t = {"now": 0.0}
+    slo = SloTracker(pm({"p0": {"primary": ["a"]}}),
+                     clock=lambda: t["now"])
+    slo.open_incident()
+    t["now"] = 4.0
+    slo.on_batch("b", [_Mv("p0", "b")], ok=False, now=4.0)
+    t["now"] = 9.0
+    assert slo.close_incident() == 9.0
+    assert slo.first_converged_lags() == [9.0]
+
+
+def test_incident_with_failure_tail_reports_the_whole_window():
+    # Executes, THEN fails until close (a dead node exhausting
+    # recovery): the incident never converged, so the lag is the whole
+    # window — not the deflating time-to-last-execute.  A failure a
+    # retry then executed PAST still reads as converged.
+    t = {"now": 0.0}
+    slo = SloTracker(pm({f"p{i}": {"primary": ["a"]} for i in range(2)}),
+                     clock=lambda: t["now"])
+    slo.open_incident()
+    t["now"] = 3.0
+    slo.on_batch("b", [_Mv("p0", "b")], ok=True, now=3.0)
+    t["now"] = 5.0
+    slo.on_batch("c", [_Mv("p1", "c")], ok=False, now=5.0)
+    t["now"] = 40.0
+    assert slo.close_incident() == 40.0  # fail tail: whole window
+    slo.open_incident()
+    t["now"] = 41.0
+    slo.on_batch("c", [_Mv("p1", "c")], ok=False, now=41.0)
+    t["now"] = 43.0
+    slo.on_batch("c", [_Mv("p1", "c")], ok=True, now=43.0)  # retry lands
+    t["now"] = 60.0
+    assert slo.close_incident() == 3.0  # converged at the retry
+    assert slo.first_converged_lags() == [40.0, 3.0]
+
+
+def test_rebalance_records_one_incident():
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    m = model(primary=(0, 1))
+    beg = pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+    rec = Recorder()
+    with use_recorder(rec):
+        r = asyncio.run(rebalance_async(
+            m, beg, ["a", "b"], ["a"], [], assign, backend="greedy"))
+    assert r.converged
+    assert "slo.first_converged_lag_s" in rec.gauges
+    assert rec.gauges["slo.first_converged_lag_s"] >= 0.0
+
+
+def test_raised_rebalance_never_leaves_a_stale_open_incident():
+    """A rebalance call that RAISES (validation error here) must
+    discard its open incident: a reused tracker's next episode opens
+    fresh instead of inheriting the failed call's start time and
+    recording an arbitrarily inflated makespan sample."""
+    async def assign(stop_ch, node, partitions, states, ops):
+        await asyncio.sleep(0)
+
+    m = model(primary=(0, 1))
+    beg = pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+    t = {"now": 100.0}
+    rec = Recorder(clock=lambda: t["now"])
+    slo = SloTracker(beg, clock=lambda: t["now"], recorder=rec)
+    with use_recorder(rec):
+        with pytest.raises(ValueError):
+            # max_recovery_rounds without fault-tolerant options raises
+            # AFTER open_incident.
+            asyncio.run(rebalance_async(
+                m, beg, ["a", "b"], ["a"], [], assign, backend="greedy",
+                max_recovery_rounds=2, slo=slo))
+        assert slo._incident_t0 is None  # discarded, not left open
+        t["now"] = 500.0  # a gap that must NOT enter the next sample
+        r = asyncio.run(rebalance_async(
+            m, beg, ["a", "b"], ["a"], [], assign, backend="greedy",
+            slo=slo))
+    assert r.converged
+    # Measured from the SECOND call's open (500.0), not the failed
+    # call's stale 100.0 (which would read 400.0).
+    assert slo.first_converged_lags() == [0.0]
+
+
+def test_controller_stop_mid_episode_discards_the_incident():
+    """A stop during a busy episode is not a quiesce: the open incident
+    dies unrecorded instead of closing as a converged-looking lag
+    sample polluting first_converged_lags."""
+    async def drive():
+        gate = asyncio.Event()
+
+        async def assign(stop_ch, node, partitions, states, ops):
+            await gate.wait()  # hold the episode in flight
+
+        m = model(primary=(0, 1))
+        beg = pm({f"p{i}": {"primary": ["a"]} for i in range(4)})
+        slo = SloTracker(beg)
+        ctl = RebalanceController(m, ["a", "b"], beg, assign,
+                                  backend="greedy", slo=slo)
+        ctl.start()
+        ctl.submit(ClusterDelta(add=("c",)))
+        await asyncio.sleep(0.05)  # let the episode reach the mover
+        gate.set()
+        await ctl.stop()
+        return slo
+
+    slo = asyncio.run(drive())
+    assert slo._incident_t0 is None  # nothing left open
+    assert slo.first_converged_lags() == []  # and nothing recorded
