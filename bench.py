@@ -866,6 +866,84 @@ def _bench_fleet_measured(B, tenants, classes, mon):
     return out
 
 
+def bench_fleet_loop(tenants=8, seed=5):
+    """Fleet-of-loops stage (ISSUE 13, docs/FLEET.md): N tenants'
+    CONTINUOUS rebalance loops — debounce, converge cycles, warm
+    carries — multiplexed over one shared plan service, coalesced
+    converge cycles vs the sequential loop-per-tenant baseline (same
+    code path, zero admission window, max_batch=1) on the same seeded
+    multi-tenant scenario under the DeterministicLoop virtual clock.
+
+    The gate: identical per-tenant final maps, equal executed moves
+    (churn) and equal availability across the two modes, strictly fewer
+    device dispatches coalesced, and higher converge-cycles/sec
+    wall-clock throughput.  Both modes are warmed first so throughput
+    compares steady-state cycle cost, not XLA compile time."""
+    from blance_tpu.testing.fleetsim import run_fleet_scenario
+    from blance_tpu.testing.scenarios import fleet_zone_outage
+
+    scn = fleet_zone_outage(seed=seed, tenants=tenants)
+    run_fleet_scenario(scn)  # warm the coalesced-mode programs
+    run_fleet_scenario(scn, coalesce=False)  # and the B=1 classes
+    # Min-of-3 wall-clock per mode (each run is deterministic in every
+    # VIRTUAL quantity; only wall_s is host-dependent and CI-noisy).
+    co_runs = [run_fleet_scenario(scn) for _ in range(3)]
+    seq_runs = [run_fleet_scenario(scn, coalesce=False)
+                for _ in range(3)]
+    co, seq = co_runs[0], seq_runs[0]
+    co.wall_s = min(r.wall_s for r in co_runs)
+    seq.wall_s = min(r.wall_s for r in seq_runs)
+
+    def nbs(maps):
+        return {t: {k: {s: list(ns)
+                        for s, ns in p.nodes_by_state.items()}
+                    for k, p in m.items()}
+                for t, m in maps.items()}
+
+    identical = nbs(co.final_maps) == nbs(seq.final_maps)
+    equal_churn = co.fleet.moves_executed == seq.fleet.moves_executed
+    equal_slo = (
+        co.fleet.availability_min == seq.fleet.availability_min and
+        {k: s.availability for k, s in co.summaries.items()} ==
+        {k: s.availability for k, s in seq.summaries.items()})
+    co_cps = co.cycles / max(co.wall_s, 1e-9)
+    seq_cps = seq.cycles / max(seq.wall_s, 1e-9)
+    out = {
+        "scenario": scn.name, "seed": seed, "tenants": tenants,
+        "identical_final_maps": identical,
+        "equal_churn": equal_churn,
+        "equal_slo": equal_slo,
+        "complete": co.complete and seq.complete,
+        "moves_executed": co.fleet.moves_executed,
+        "plan_requests": co.plan_requests,
+        "dispatches_coalesced": co.dispatches,
+        "dispatches_sequential": seq.dispatches,
+        "dispatch_reduction": round(
+            seq.dispatches / max(co.dispatches, 1), 2),
+        "carry_hits_coalesced": co.carry_hits,
+        "converge_cycles": co.cycles,
+        "wall_s_coalesced": round(co.wall_s, 3),
+        "wall_s_sequential": round(seq.wall_s, 3),
+        "cycles_per_s_coalesced": round(co_cps, 1),
+        "cycles_per_s_sequential": round(seq_cps, 1),
+        "admission_p50_ms": round(co.admission_p50_s * 1000, 2),
+        "admission_p99_ms": round(co.admission_p99_s * 1000, 2),
+        "starved_admissions": co.starved_admissions,
+    }
+    out["pass"] = bool(
+        identical and equal_churn and equal_slo and out["complete"]
+        and co.dispatches < seq.dispatches and co_cps > seq_cps)
+    log(f"[fleet_loop {tenants} tenants seed={seed}] "
+        f"dispatches {seq.dispatches}->{co.dispatches} "
+        f"({out['dispatch_reduction']}x fewer), cycles/s "
+        f"{out['cycles_per_s_sequential']}->"
+        f"{out['cycles_per_s_coalesced']}, identical={identical} "
+        f"equal_churn={equal_churn} equal_slo={equal_slo} "
+        f"admission p50/p99 {out['admission_p50_ms']}/"
+        f"{out['admission_p99_ms']}ms (virtual)")
+    return out
+
+
 def _timed(fn):
     t0 = time.perf_counter()
     fn()
@@ -1777,13 +1855,26 @@ def _run_perf_smoke():
         sched_ok = False
     ok = ok and sched_ok
 
+    # Fleet-loop gate (ISSUE 13): coalesced converge cycles must land
+    # on the IDENTICAL per-tenant final maps as the sequential
+    # loop-per-tenant baseline at equal churn and equal SLO, with
+    # measurably fewer device dispatches and higher converge-cycles/sec
+    # — the fleet tier's dispatch-economics win must not silently erode.
+    try:
+        floop = bench_fleet_loop()
+        floop_ok = floop["pass"]
+    except Exception as e:  # any stage crash must fail THIS gate
+        floop = {"error": first_line(e)}
+        floop_ok = False
+    ok = ok and floop_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
         "unit": "sweeps",
         "vs_baseline": res["cold_sweeps"],
         "detail": {**res, "pipeline": pipe, "sparse": sparse,
-                   "sched": sched},
+                   "sched": sched, "fleet_loop": floop},
         "pass": ok,
     }))
     if not ok:
@@ -1791,7 +1882,8 @@ def _run_perf_smoke():
             f"cold={res['cold_sweeps']} (hit={res['warm_carry_hit']}, "
             f"identical={res['identical']}); pipeline "
             f"{'OK' if pipe_ok else f'FAILED: {pipe}'}; sparse "
-            f"{'OK' if sparse_ok else f'FAILED: {sparse}'}")
+            f"{'OK' if sparse_ok else f'FAILED: {sparse}'}; fleet_loop "
+            f"{'OK' if floop_ok else f'FAILED: {floop}'}")
         sys.exit(1)
 
 
@@ -1949,6 +2041,17 @@ def _run_benchmarks(smoke, backend_note=None):
         log(f"simulate stage failed ({type(e).__name__}: {first_line(e)})")
         detail["simulate_error"] = first_line(e)
     save_progress(detail, "simulate done")
+
+    # Fleet-loop stage: N tenants' coalesced converge cycles vs the
+    # sequential loop-per-tenant baseline (identical final maps, equal
+    # churn, fewer device dispatches — ISSUE 13, docs/FLEET.md).
+    try:
+        detail["fleet_loop"] = bench_fleet_loop()
+    except Exception as e:  # must not eat the solve numbers
+        log(f"fleet-loop stage failed "
+            f"({type(e).__name__}: {first_line(e)})")
+        detail["fleet_loop_error"] = first_line(e)
+    save_progress(detail, "fleet-loop done")
 
     # Cost-model stage: EWMA (node, op) move costs calibrated from the
     # chaos run's move-lifecycle spans, scored predicted-vs-actual.

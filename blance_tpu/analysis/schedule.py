@@ -722,6 +722,106 @@ async def _supersede_mid_rebalance() -> dict[str, int]:
             "cancels": ctl.superseded}
 
 
+async def _fleet_coalesce_window() -> dict[str, int]:
+    """The plan service's coalescing window under admission fairness
+    (ISSUE 13): a chatty tenant fires three CONCURRENT requests against
+    ``fair_share=1`` while two calm neighbors submit one each, under
+    arbitrary interleavings of the submitters, the dispatcher and the
+    window timer.  Invariants: every future resolves exactly once with
+    its own tenant's bit-exact single-problem solve (cross-wiring would
+    surface as a foreign assign), no batch ever holds more than
+    fair_share requests of one key, the starved counter equals the
+    observed deferral events, and stop() strands nothing."""
+    import numpy as np
+
+    from ..obs import Recorder, use_recorder
+    from ..plan.fleet import TenantProblem, solve_fleet
+    from ..plan.service import PlanService
+
+    loop = asyncio.get_running_loop()
+
+    def tenant(key: str, seed: int) -> Any:
+        P, N, S, R = 2, 3, 1, 1
+        prev = np.full((P, S, R), -1, np.int32)
+        prev[0, 0, 0] = seed % N
+        prev[1, 0, 0] = (seed + 1) % N
+        return TenantProblem(
+            key=key, prev=prev,
+            partition_weights=np.ones(P, np.float32),
+            node_weights=np.ones(N, np.float32),
+            valid_node=np.ones(N, bool),
+            stickiness=np.full((P, S), 1.5, np.float32),
+            gids=np.arange(N, dtype=np.int32).reshape(1, N),
+            gid_valid=np.ones((1, N), bool),
+            constraints=(1,), rules=((),))
+
+    seeds = {"chatty": 0, "calm-b": 1, "calm-c": 2}
+    # The oracle: each tenant's single-problem fleet solve (the service
+    # result must be bit-identical to it, whatever the batching).
+    expected = {key: solve_fleet([tenant(key, s)], record=False)[0].assign
+                for key, s in seeds.items()}
+
+    batches: list[list[str]] = []
+    deferrals = 0
+
+    class _Capturing(PlanService):
+        def _solve_batch(self, problems: list[Any],
+                         trace_ids: dict[str, Any]) -> Any:
+            batches.append([t.key for t in problems])
+            return super()._solve_batch(problems, trace_ids)
+
+        def _defer(self, req: Any) -> None:
+            nonlocal deferrals
+            deferrals += 1
+            super()._defer(req)
+
+    rec = Recorder(clock=loop.time)
+    with use_recorder(rec):
+        svc = _Capturing(admission_window_s=0.01, fair_share=1,
+                         inline_solve=True, max_pending=8, recorder=rec)
+        await svc.start()
+        results: dict[str, Any] = {}
+
+        async def one(key: str, tag: str) -> None:
+            results[tag] = await svc.submit(tenant(key, seeds[key]))
+
+        tasks = [asyncio.ensure_future(one("chatty", f"chatty{i}"))
+                 for i in range(3)]
+        tasks += [asyncio.ensure_future(one("calm-b", "b")),
+                  asyncio.ensure_future(one("calm-c", "c"))]
+        await asyncio.gather(*tasks)
+        await svc.stop()
+
+    if len(results) != 5:
+        raise InvariantViolation(
+            f"{5 - len(results)} submit futures never resolved")
+    for tag, res in results.items():
+        key = "chatty" if tag.startswith("chatty") else \
+            ("calm-b" if tag == "b" else "calm-c")
+        if res.key != key:
+            raise InvariantViolation(
+                f"request {tag} resolved with tenant {res.key!r}: "
+                f"cross-wired batch")
+        if not np.array_equal(res.assign, expected[key]):
+            raise InvariantViolation(
+                f"request {tag} diverged from the single-problem "
+                f"oracle: batching must be bit-neutral")
+    for keys in batches:
+        counts: dict[str, int] = {}
+        for k in keys:
+            counts[k] = counts.get(k, 0) + 1
+        if any(c > 1 for c in counts.values()):
+            raise InvariantViolation(
+                f"a batch exceeded fair_share=1 for one tenant: {keys}")
+    starved = int(rec.counters.get("fleet.starved_admissions", 0))
+    if starved != deferrals:
+        raise InvariantViolation(
+            f"starved counter {starved} != observed deferral events "
+            f"{deferrals}")
+    return {"batches": len(batches), "starved": starved,
+            "resolved": len(results)}
+
+
 SCENARIOS: dict[str, Scenario] = {
     s.name: s for s in (
         Scenario(
@@ -769,6 +869,13 @@ SCENARIOS: dict[str, Scenario] = {
                 "tasks) and lands on the sequential run's final map "
                 "(seeded chaos walks)",
             factory=_supersede_mid_rebalance),
+        Scenario(
+            name="fleet_coalesce_window",
+            doc="plan-service coalescing window under admission "
+                "fairness: a chatty tenant vs fair_share=1 — every "
+                "request resolves bit-exactly, no batch over quota, "
+                "starved counter consistent (seeded chaos walks)",
+            factory=_fleet_coalesce_window),
     )
 }
 

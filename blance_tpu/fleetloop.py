@@ -1,0 +1,402 @@
+"""Fleet of control loops: N tenants' continuous rebalance through one
+coalesced plan dispatch (ROADMAP item 3 — the production shape).
+
+The paper's deployment (cbgt/FTS at millions of users) is not one
+cluster rebalancing once: it is hundreds of tenant *indexes*, each
+running its own continuous rebalance loop over a shared node fleet.
+PR 7 made many tenants' *solves* one vmapped dispatch
+(``plan/fleet.py`` + ``plan/service.py``); PR 10 closed *one* tenant's
+loop (``rebalance.RebalanceController``).  This module composes them:
+
+- each tenant runs a full :class:`~blance_tpu.rebalance.
+  RebalanceController` — the extracted
+  :class:`~blance_tpu.control.CycleEngine` cycle machine — as ONE task
+  on a single shared event loop (no thread per tenant);
+- every controller plans through a :class:`ServicePlanner`, the
+  :class:`~blance_tpu.control.CyclePlanner` that encodes the tenant's
+  map problem to dense arrays, submits it to the ONE shared
+  :class:`~blance_tpu.plan.service.PlanService`, and decodes the
+  result — so tenants whose debounce windows overlap land their
+  converge cycles in the SAME bucketed ``[B, ...]`` fleet dispatch
+  (GSPMD-style shape bucketing keeps compiled programs shared as
+  tenant shapes drift, arXiv:2105.04663).  The steady-state cost of N
+  loops is a handful of bucketed programs, not N dispatches;
+- per-tenant warm carries ride the service's shared
+  :class:`~blance_tpu.plan.carry.CarryCache` under a conservative
+  protocol (below) in which a cache eviction or invalidation only ever
+  costs a cold solve — never a stale or wrong map;
+- the service's ``fair_share`` quota gives cross-tenant admission
+  fairness: a chatty tenant churning weight deltas cannot fill a
+  coalescing window and starve its neighbors
+  (``fleet.starved_admissions``);
+- per-tenant SLO accounts aggregate into the fleet-wide
+  ``slo.fleet_*`` / ``fleet.*`` scorecard
+  (:class:`~blance_tpu.obs.slo.FleetSloRollup`), rendered by the
+  existing exposition plane.
+
+Warm-carry protocol (the ServicePlanner side of the CarryCache's
+"eviction is always safe" contract): a request states its delta
+(``dirty``) — and thereby opts into the one-sweep warm repair — ONLY
+when, versus the planner's previous request, (a) the partition set and
+every array shape are unchanged, (b) partition AND node weights are
+byte-identical (a re-priced problem invalidates the carry, exactly like
+``PlannerSession.set_partition_weights``), and (c) the dark-node set
+did not shrink (returned capacity must re-balance, which only a cold
+solve does).  The dirty mask is then the holders of currently-dark
+nodes; the service's value-match of ``prev`` against the cached
+assignment catches everything else (superseded passes, failures,
+mid-flight divergence) and demotes to cold.  Cold is always correct —
+it is the single-problem solve on the current inputs.
+
+Determinism: everything here is loop-only when the service runs
+``inline_solve=True`` — under ``testing.sched.DeterministicLoop`` a
+multi-hundred-tenant virtual week replays bit-identically
+(``testing/fleetsim.py``, docs/SIMULATOR.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .control import CyclePlanner
+from .core.encode import decode_assignment, encode_problem
+from .core.types import PartitionMap, PartitionModel, PlanOptions
+from .obs import get_recorder
+from .obs.slo import FleetSloRollup, FleetSloSummary, SloTracker
+from .orchestrate.orchestrator import OrchestratorOptions
+from .plan.fleet import TenantProblem
+from .plan.service import PlanService
+from .rebalance import ClusterDelta, RebalanceController
+
+__all__ = ["FleetController", "ServicePlanner", "TenantLoop"]
+
+
+class ServicePlanner(CyclePlanner):
+    """One tenant's :class:`~blance_tpu.control.CyclePlanner` over the
+    shared :class:`~blance_tpu.plan.service.PlanService` (module doc:
+    encode → submit → decode, with the conservative warm protocol)."""
+
+    def __init__(self, key: str, service: PlanService) -> None:
+        self.key = key
+        self._service = service
+        # Fingerprint of the previous request: (dark set, partition
+        # list, prev shape, N, pweights bytes, nweights bytes).  None
+        # until the first cycle — the first request is always cold.
+        self._last: Optional[tuple[frozenset[str], tuple[str, ...],
+                                   tuple[int, ...], int, bytes,
+                                   bytes]] = None
+
+    async def plan_cycle(
+        self,
+        current: PartitionMap,
+        nodes: list[str],
+        removes: list[str],
+        model: PartitionModel,
+        opts: PlanOptions,
+    ) -> tuple[PartitionMap, dict[str, list[str]]]:
+        if opts.node_score_booster is not None or \
+                opts.node_scorer is not None or \
+                opts.node_sorter is not None:
+            raise ValueError(
+                f"tenant {self.key!r}: the fleet plan service runs the "
+                f"dense batch solver, which does not support "
+                f"node_score_booster/node_scorer/node_sorter hooks — "
+                f"run this tenant on a local planner instead")
+        problem = encode_problem(current, current, nodes, removes,
+                                 model, opts)
+        fp = (frozenset(removes), tuple(problem.partitions),
+              tuple(problem.prev.shape), problem.N,
+              problem.partition_weights.tobytes(),
+              problem.node_weights.tobytes())
+        dirty = self._dirty_for(problem, fp)
+        tenant = TenantProblem.from_dense(self.key, problem, dirty=dirty)
+        result = await self._service.submit(tenant)
+        next_map, warnings = decode_assignment(
+            problem, result.assign, current, removes)
+        self._last = fp
+        return next_map, warnings
+
+    def _dirty_for(self, problem: Any,
+                   fp: tuple) -> Optional[np.ndarray]:
+        """The request's delta mask when the warm path MAY run, else
+        None (cold — see the module doc's warm-carry protocol)."""
+        last = self._last
+        if last is None:
+            return None
+        dark, parts, shape, n, pw, nw = fp
+        ldark, lparts, lshape, ln, lpw, lnw = last
+        if parts != lparts or shape != lshape or n != ln:
+            return None  # re-shaped problem: any carry is stale
+        if pw != lpw or nw != lnw:
+            return None  # re-priced problem: the carry's fills lie
+        if not (ldark <= dark):
+            return None  # capacity returned: only a cold solve rebalances
+        dark_ids = np.array(
+            [i for i, name in enumerate(problem.nodes) if name in dark],
+            np.int32)
+        dirty: np.ndarray = np.isin(problem.prev, dark_ids).any(
+            axis=(1, 2))
+        return dirty
+
+
+@dataclasses.dataclass
+class TenantLoop:
+    """One tenant's registered control loop."""
+
+    key: str
+    controller: RebalanceController
+    planner: ServicePlanner
+    slo: SloTracker
+
+
+class FleetController:
+    """N per-tenant rebalance loops multiplexed over one shared plan
+    service + carry cache on a single event loop (module doc).
+
+    ``coalesce=False`` is the sequential loop-per-tenant BASELINE: the
+    same code path with a zero admission window and ``max_batch=1``,
+    so every tenant plan costs its own device dispatch — the
+    configuration the ``fleet_loop`` bench stage beats (identical
+    final maps, measurably fewer dispatches; docs/FLEET.md).
+
+    Shared state (analysis/race_lint.py SHARED_STATE): the tenant
+    registry is mutated only from the driving task (``add_tenant`` /
+    ``forget_tenant``), in sync windows; each controller's own state
+    follows the CycleEngine discipline; the rollup and the service are
+    single-window by their own contracts.
+    """
+
+    def __init__(
+        self,
+        nodes_all: list[str],
+        *,
+        service: Optional[PlanService] = None,
+        coalesce: bool = True,
+        admission_window_s: float = 0.002,
+        fair_share: Optional[int] = None,
+        max_batch: int = 1024,
+        max_pending: int = 4096,
+        carry_bytes: Optional[int] = 64 << 20,
+        carry_entries: Optional[int] = 16384,
+        mesh: Optional[Any] = None,
+        inline_solve: bool = False,
+        batch_floor: int = 16,
+        orchestrator_options: Optional[OrchestratorOptions] = None,
+        plan_options: Optional[PlanOptions] = None,
+        debounce_s: float = 0.05,
+        max_passes_per_cycle: int = 8,
+        availability_floor: Optional[float] = None,
+        recorder: Optional[Any] = None,
+    ) -> None:
+        self.nodes_all = list(nodes_all)
+        self._rec = recorder if recorder is not None else get_recorder()
+        self._own_service = service is None
+        if service is None:
+            service = PlanService(
+                admission_window_s=admission_window_s if coalesce
+                else 0.0,
+                max_batch=max_batch if coalesce else 1,
+                max_pending=max_pending,
+                fair_share=fair_share if coalesce else None,
+                carry_bytes=carry_bytes,
+                carry_entries=carry_entries,
+                mesh=mesh,
+                inline_solve=inline_solve,
+                # Both modes share the floored batch programs: a fleet
+                # of loops dispatches many SMALL batches (sequential
+                # mode: all B=1), and without the floor every distinct
+                # coalesced size compiles its own program.
+                batch_floor=batch_floor,
+                recorder=self._rec,
+            )
+        self.service = service
+        self.coalesce = coalesce
+        self.orch_opts = orchestrator_options or OrchestratorOptions()
+        self.plan_options = plan_options
+        self.debounce_s = debounce_s
+        self.max_passes_per_cycle = max_passes_per_cycle
+        self.availability_floor = availability_floor
+        self._tenants: dict[str, TenantLoop] = {}
+        self.rollup = FleetSloRollup(
+            availability_floor, recorder=self._rec,
+            clock=self._rec.now)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Start the shared plan service (own-service mode only; a
+        caller-supplied service is the caller's lifecycle)."""
+        if self._own_service:
+            await self.service.start()
+
+    async def stop(self) -> None:
+        """Stop every tenant loop, then the shared service (in that
+        order: a stopping controller may still await one last plan).
+
+        A tenant engine that died with an exception must not abort the
+        wind-down partway (stranding its neighbors' tasks and leaking
+        the service's dispatcher/executor): every loop is stopped and
+        the service closed first, then the FIRST tenant failure is
+        re-raised so the crash still surfaces to the caller."""
+        for loop in self._tenants.values():
+            loop.controller.stop_soon()
+        first_error: Optional[BaseException] = None
+        first_key: Optional[str] = None
+        for loop in self._tenants.values():
+            try:
+                await loop.controller.stop()
+            except (Exception, asyncio.CancelledError) as exc:
+                # CancelledError included: a supervisor that cancelled
+                # one engine task must not abort THIS wind-down partway
+                # (CancelledError is a BaseException on 3.8+).
+                if first_error is None:
+                    first_error, first_key = exc, loop.key
+        if self._own_service:
+            await self.service.stop()
+        self.publish_rollup()
+        if first_error is not None:
+            raise RuntimeError(
+                f"tenant {first_key!r} controller died during the "
+                f"run") from first_error
+
+    # -- tenants -----------------------------------------------------------
+
+    def add_tenant(
+        self,
+        key: str,
+        model: PartitionModel,
+        initial_map: PartitionMap,
+        assign_partitions: Callable[..., object],
+        *,
+        plan_options: Optional[PlanOptions] = None,
+        orchestrator_options: Optional[OrchestratorOptions] = None,
+        move_observers: tuple = (),
+        kick: bool = False,
+    ) -> RebalanceController:
+        """Onboard one tenant: spawn its controller task on the running
+        loop, wire its ServicePlanner + SLO tracker, register it with
+        the rollup.  ``kick=True`` submits an empty delta so an
+        onboarding tenant (empty placements) converges to a full map
+        immediately — the staggered-onboarding entry point."""
+        if key in self._tenants:
+            raise ValueError(f"tenant {key!r} already registered")
+        effective_opts = (plan_options if plan_options is not None
+                          else self.plan_options)
+        if effective_opts is not None and (
+                effective_opts.node_score_booster is not None
+                or effective_opts.node_scorer is not None
+                or effective_opts.node_sorter is not None):
+            # Surface the misconfiguration HERE, where the caller can
+            # handle it — inside the engine task it would kill the
+            # tenant's loop silently (quiesce still returns, with a
+            # stale map) and only resurface at stop().
+            raise ValueError(
+                f"tenant {key!r}: the fleet plan service runs the dense "
+                f"batch solver, which does not support node_score_"
+                f"booster/node_scorer/node_sorter hooks — run this "
+                f"tenant on a standalone RebalanceController instead")
+        top = min((st.priority for st in model.values()), default=0)
+        slo = SloTracker(
+            initial_map,
+            primary_states=[s for s, st in model.items()
+                            if st.priority == top],
+            clock=self._rec.now, recorder=self._rec,
+            track_timeline=True,
+            availability_floor=self.availability_floor,
+            publish_gauges=False)
+        planner = ServicePlanner(key, self.service)
+        controller = RebalanceController(
+            model, list(self.nodes_all), initial_map, assign_partitions,
+            plan_options=(plan_options if plan_options is not None
+                          else self.plan_options),
+            orchestrator_options=(orchestrator_options
+                                  if orchestrator_options is not None
+                                  else self.orch_opts),
+            backend="greedy",  # degradation-path fallback only
+            planner=planner,
+            debounce_s=self.debounce_s,
+            max_passes_per_cycle=self.max_passes_per_cycle,
+            slo=slo, move_observers=move_observers)
+        self._tenants[key] = TenantLoop(key, controller, planner, slo)
+        self.rollup.register(key, slo)
+        controller.start()
+        if kick:
+            controller.submit(ClusterDelta())
+        self.publish_rollup()
+        return controller
+
+    def forget_tenant(self, key: str) -> None:
+        """Drop a tenant's registration (the caller stops its
+        controller); its carry-cache entry ages out via the LRU."""
+        self._tenants.pop(key, None)
+        self.rollup.forget(key)
+        self.publish_rollup()
+
+    def tenant(self, key: str) -> TenantLoop:
+        return self._tenants[key]
+
+    def tenants(self) -> list[TenantLoop]:
+        return list(self._tenants.values())
+
+    def keys(self) -> list[str]:
+        return list(self._tenants)
+
+    # -- delta fan-out -----------------------------------------------------
+
+    def submit(self, key: str, delta: ClusterDelta) -> None:
+        """One tenant's delta (weight drift, tenant-local churn)."""
+        self._tenants[key].controller.submit(delta)
+
+    def submit_all(self, delta: ClusterDelta) -> None:
+        """Fan one cluster-wide membership delta to EVERY tenant loop —
+        a correlated zone outage is one event, N coalesced converge
+        cycles, a handful of fleet dispatches."""
+        for loop in self._tenants.values():
+            loop.controller.submit(delta)
+
+    # -- rendezvous & scorecard --------------------------------------------
+
+    async def quiesce_all(self) -> dict[str, PartitionMap]:
+        """Wait until every tenant loop is idle; returns each tenant's
+        current map (registration order — deterministic under the
+        DeterministicLoop)."""
+        out: dict[str, PartitionMap] = {}
+        for key, loop in self._tenants.items():
+            out[key] = await loop.controller.quiesce()
+        self.publish_rollup()
+        return out
+
+    def publish_rollup(self) -> None:
+        """Refresh the fleet-wide gauges (collector-compatible: hand
+        this to a ``MetricsServer(collectors=...)``)."""
+        self._rec.set_gauge(
+            "fleet.converge_cycles",
+            float(sum(loop.controller.cycles
+                      for loop in self._tenants.values())))
+        self.rollup.publish()
+
+    def summary(self) -> FleetSloSummary:
+        """The fleet scorecard (per-tenant summaries included)."""
+        return self.rollup.summary()
+
+    @property
+    def cycles(self) -> int:
+        return sum(t.controller.cycles for t in self._tenants.values())
+
+    @property
+    def passes(self) -> int:
+        return sum(t.controller.passes for t in self._tenants.values())
+
+    @property
+    def superseded(self) -> int:
+        return sum(t.controller.superseded
+                   for t in self._tenants.values())
+
+    @property
+    def unconverged_cycles(self) -> int:
+        return sum(t.controller.unconverged_cycles
+                   for t in self._tenants.values())

@@ -345,6 +345,32 @@ def default_registry() -> MetricsRegistry:
                "per-request latency decomposition (labeled by segment: "
                "admission/coalesce/executor_queue/device/resolve; the "
                "segments tile submit-to-resolve exactly)"),
+        # -- fleet of control loops (blance_tpu/fleetloop.py +
+        # plan/service.py fairness + plan/carry.py evictions) ----------------
+        Metric("fleet.starved_admissions", "counter",
+               "plan requests rolled out of a coalescing window by the "
+               "per-tenant fair-share quota (one count per deferral "
+               "event; the cross-tenant starvation observable)"),
+        Metric("fleet.carry_evictions", "counter",
+               "warm-carry cache evictions, labeled by reason (bytes = "
+               "byte-budget LRU, entries = key-count LRU drop, shape = "
+               "re-shaped problem reset) — every one costs the key one "
+               "cold solve"),
+        Metric("fleet.tenants", "gauge",
+               "tenant control loops registered with the fleet rollup"),
+        Metric("fleet.converge_cycles", "gauge",
+               "converge cycles completed across every tenant loop "
+               "(fleet-controller rollup)"),
+        Metric("slo.fleet_availability_min", "gauge",
+               "minimum partition availability across all tenant loops "
+               "(the fleet's worst tenant)"),
+        Metric("slo.fleet_availability_mean", "gauge",
+               "mean partition availability across all tenant loops"),
+        Metric("slo.fleet_tenants_below_floor", "gauge",
+               "tenant loops currently below their availability floor"),
+        Metric("slo.fleet_violation_seconds", "gauge",
+               "cumulative SLO-violation seconds summed across all "
+               "tenant loops"),
         # -- device (obs/device.py; all emitted only while the device
         # observatory is enabled) ---------------------------------------------
         Metric("device.compiles", "counter",

@@ -61,7 +61,8 @@ from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, \
 
 from .recorder import Recorder, escape_label_value, get_recorder
 
-__all__ = ["MoveObserver", "SloSummary", "SloTracker"]
+__all__ = ["FleetSloRollup", "FleetSloSummary", "MoveObserver",
+           "SloSummary", "SloTracker"]
 
 # Kept as the module-local spelling; the one implementation lives in
 # obs/recorder.py so it cannot drift from obs/device.py's labels.
@@ -124,8 +125,15 @@ class SloTracker:
                  clock: Optional[Callable[[], float]] = None,
                  recorder: Optional[Recorder] = None,
                  track_timeline: bool = False,
-                 availability_floor: Optional[float] = None) -> None:
+                 availability_floor: Optional[float] = None,
+                 publish_gauges: bool = True) -> None:
         self._rec = recorder
+        # publish_gauges=False keeps the whole account (summaries,
+        # timelines, incidents) but silences the slo.* gauge writes: a
+        # fleet of per-tenant trackers must not fight last-writer-wins
+        # over one process-wide gauge set — the FleetSloRollup publishes
+        # the aggregate instead (docs/FLEET.md).
+        self._publish_gauges = publish_gauges
         self._clock: Callable[[], float] = (
             clock if clock is not None
             else (recorder.now if recorder is not None else time.perf_counter))
@@ -389,7 +397,11 @@ class SloTracker:
 
     def publish(self, now: Optional[float] = None) -> None:
         """Write every gauge into the recorder (``slo.*``).  Collector-
-        compatible: a MetricsServer calls this before each snapshot."""
+        compatible: a MetricsServer calls this before each snapshot.
+        No-op when the tracker was built with ``publish_gauges=False``
+        (fleet mode: the rollup owns the process-wide gauges)."""
+        if not self._publish_gauges:
+            return
         rec = self._rec if self._rec is not None else get_recorder()
         t = self._clock() if now is None else now
         rec.set_gauge("slo.partition_availability", self.availability())
@@ -438,3 +450,108 @@ class SloTracker:
             violation_s=self.violation_s(t),
             violation_intervals=self.violation_intervals(t),
         )
+
+
+@dataclass
+class FleetSloSummary:
+    """One fleet-wide SLO reading rolled up over every tenant loop
+    (``FleetSloRollup.summary``; the fleet simulator's scorecard and
+    the ``slo.fleet_*`` gauges' source of truth)."""
+
+    tenants: int
+    availability_min: float
+    availability_mean: float
+    tenants_below_floor: int
+    availability_floor: Optional[float]
+    moves_executed: int
+    moves_failed: int
+    violation_s: float
+    # The tenant at availability_min (ties: first registration order) —
+    # the "who is hurting" pointer the scorecard renders.
+    worst_tenant: Optional[str] = None
+    per_tenant: dict[str, SloSummary] = field(default_factory=dict)
+
+
+class FleetSloRollup:
+    """Fleet-wide rollup over per-tenant :class:`SloTracker`\\ s.
+
+    The fleet-of-loops tier (``blance_tpu/fleetloop.py``) runs one
+    tracker per tenant; this class aggregates them into one scorecard —
+    minimum / mean availability across tenants, how many sit below the
+    SLO floor, total executed/failed moves, cumulative violation
+    seconds — published as ``slo.fleet_*`` / ``fleet.tenants`` gauges
+    so the EXISTING exposition plane (``obs/expo.py``
+    ``MetricsServer``) renders the whole fleet without any new
+    endpoint.  ``publish`` is collector-compatible: pass it in a
+    ``MetricsServer(collectors=...)`` so every scrape snapshots a fresh
+    rollup.
+
+    Single-task discipline (analysis/race_lint.py SHARED_STATE): every
+    method is sync with no await — registration happens on the fleet
+    controller's task, reads on the exposition snapshot path — so the
+    registry cannot tear mid-rollup."""
+
+    def __init__(self, availability_floor: Optional[float] = None,
+                 recorder: Optional[Recorder] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self._rec = recorder
+        self._floor = availability_floor
+        self._clock: Callable[[], float] = (
+            clock if clock is not None
+            else (recorder.now if recorder is not None
+                  else time.perf_counter))
+        self._trackers: dict[str, SloTracker] = {}
+
+    def register(self, key: str, tracker: SloTracker) -> None:
+        """Adopt one tenant loop's tracker (re-registering a key
+        replaces it — a re-onboarded tenant starts a fresh account)."""
+        self._trackers[key] = tracker
+
+    def forget(self, key: str) -> None:
+        self._trackers.pop(key, None)
+
+    def keys(self) -> list[str]:
+        return list(self._trackers)
+
+    def summary(self, now: Optional[float] = None,
+                per_tenant: bool = True) -> FleetSloSummary:
+        t = self._clock() if now is None else now
+        avail: list[tuple[str, float]] = [
+            (k, tr.availability()) for k, tr in self._trackers.items()]
+        below = sum(1 for _k, a in avail
+                    if self._floor is not None and a < self._floor)
+        worst: Optional[str] = None
+        amin = 1.0
+        for k, a in avail:
+            if a < amin:
+                amin, worst = a, k
+        return FleetSloSummary(
+            tenants=len(avail),
+            availability_min=amin if avail else 1.0,
+            availability_mean=(sum(a for _k, a in avail) / len(avail)
+                               if avail else 1.0),
+            tenants_below_floor=below,
+            availability_floor=self._floor,
+            moves_executed=sum(tr.moves_executed
+                               for tr in self._trackers.values()),
+            moves_failed=sum(tr.moves_failed
+                             for tr in self._trackers.values()),
+            violation_s=sum(tr.violation_s(t)
+                            for tr in self._trackers.values()),
+            worst_tenant=worst,
+            per_tenant=({k: tr.summary(t)
+                         for k, tr in self._trackers.items()}
+                        if per_tenant else {}),
+        )
+
+    def publish(self, now: Optional[float] = None) -> None:
+        """Write the fleet gauges (collector-compatible, like
+        :meth:`SloTracker.publish`)."""
+        rec = self._rec if self._rec is not None else get_recorder()
+        s = self.summary(now, per_tenant=False)
+        rec.set_gauge("fleet.tenants", float(s.tenants))
+        rec.set_gauge("slo.fleet_availability_min", s.availability_min)
+        rec.set_gauge("slo.fleet_availability_mean", s.availability_mean)
+        rec.set_gauge("slo.fleet_tenants_below_floor",
+                      float(s.tenants_below_floor))
+        rec.set_gauge("slo.fleet_violation_seconds", s.violation_s)

@@ -35,7 +35,7 @@ carry's eviction (a cold solve absorbs them on the next promote).
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING, Any, Optional
 
 import numpy as np
 
@@ -187,6 +187,15 @@ class CarryCache:
     eviction — the key's next replan is a cold start, which absorbs
     any delta the dropped masks recorded.
 
+    Evictions are NEVER silent: every one counts
+    ``fleet.carry_evictions{reason=...}`` (``bytes`` — byte-budget LRU,
+    ``entries`` — key-count LRU drop, ``shape`` — an entry reset
+    because its problem was re-shaped) on ``recorder`` (the process
+    recorder by default) and accumulates in :attr:`evictions` /
+    :meth:`stats`, so a fleet's cold solves are attributable to the
+    cache pressure that caused them instead of reading as unexplained
+    warm-path misses (docs/FLEET.md "Carry-cache tuning").
+
     Single-task discipline (analysis/race_lint.py SHARED_STATE): every
     method is synchronous and mutates under one event-loop window; the
     plan service serializes all cache writes on its dispatcher task,
@@ -194,7 +203,8 @@ class CarryCache:
     """
 
     def __init__(self, max_bytes: Optional[int] = None,
-                 max_entries: Optional[int] = None) -> None:
+                 max_entries: Optional[int] = None,
+                 recorder: "Optional[Any]" = None) -> None:
         if max_bytes is not None and max_bytes < 0:
             raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
         if max_entries is not None and max_entries < 1:
@@ -202,6 +212,7 @@ class CarryCache:
                 f"max_entries must be >= 1, got {max_entries}")
         self.max_bytes = max_bytes
         self.max_entries = max_entries
+        self._rec = recorder
         self._entries: dict[str, CarryEntry] = {}
         self._clock = 0
         # Running byte total, adjusted by _adjust around every carry
@@ -209,12 +220,38 @@ class CarryCache:
         # (store() runs once per tenant per batch on the dispatcher's
         # event-loop thread).
         self._bytes = 0
+        # Eviction counts by reason (the stats() twin of the
+        # fleet.carry_evictions labeled counter).
+        self.evictions: dict[str, int] = {}
 
     # -- bookkeeping ---------------------------------------------------------
 
     def _touch(self, e: CarryEntry) -> None:
         self._clock += 1
         e._tick = self._clock
+
+    def _note_eviction(self, reason: str) -> None:
+        """One eviction's accounting (sync window): the labeled
+        ``fleet.carry_evictions`` counter plus the stats() dict, so the
+        cold solve this eviction will cost is attributable."""
+        self.evictions[reason] = self.evictions.get(reason, 0) + 1
+        rec = self._rec
+        if rec is None:
+            from ..obs import get_recorder
+
+            rec = get_recorder()
+        rec.count(f'fleet.carry_evictions{{reason="{reason}"}}')
+
+    def stats(self) -> dict[str, object]:
+        """Cache-pressure snapshot: live entry/byte load against the
+        budgets, plus cumulative evictions by reason."""
+        return {
+            "entries": len(self._entries),
+            "bytes": self.nbytes(),
+            "max_bytes": self.max_bytes,
+            "max_entries": self.max_entries,
+            "evictions": dict(self.evictions),
+        }
 
     class _Adjust:
         """Context manager bracketing one entry's carry mutation: the
@@ -244,6 +281,8 @@ class CarryCache:
         if e is None or e.dirty.shape[0] != partitions:
             if e is not None:  # shape reset drops the old carries
                 self._bytes -= e.nbytes()
+                if e.carry is not None or e.pending is not None:
+                    self._note_eviction("shape")
             e = CarryEntry(partitions)
             self._entries[key] = e
             # Entry creation is the growth edge: enforce the key-count
@@ -283,8 +322,15 @@ class CarryCache:
             for key in sorted(self._entries,
                               key=lambda k: self._entries[k]._tick
                               )[:excess]:
-                self._bytes -= self._entries[key].nbytes()
+                e = self._entries[key]
+                self._bytes -= e.nbytes()
                 del self._entries[key]
+                if e.carry is not None or e.pending is not None:
+                    # Count only drops that cost a cold solve (the
+                    # counter's contract); an already-empty entry loses
+                    # nothing but its masks, which a cold start absorbs
+                    # anyway — same guard as the shape-reset path.
+                    self._note_eviction("entries")
         if self.max_bytes is None:
             return
         total = self.nbytes()
@@ -304,6 +350,7 @@ class CarryCache:
             e.pending = None
             self._bytes -= freed
             total -= freed
+            self._note_eviction("bytes")
             if total <= self.max_bytes:
                 return
 

@@ -408,7 +408,8 @@ def _pad_batch(stacked: Sequence[np.ndarray],
 
 def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
               k: BatchClass, max_iterations: int, fused_score: str,
-              rec, record: bool) -> tuple[np.ndarray, ...]:
+              rec, record: bool,
+              batch_floor: int = 1) -> tuple[np.ndarray, ...]:
     """Run one class batch on device (vmapped; mesh-sharded when given);
     returns host arrays, batch padding stripped.
 
@@ -416,9 +417,14 @@ def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
     bucketing treatment as P and N: B pads up to ``bucket_size(B)``
     (and to mesh divisibility), so a service whose coalesced batch
     sizes drift round to round reuses one compiled program per bucket
-    instead of recompiling per size."""
+    instead of recompiling per size.  ``batch_floor`` additionally
+    rounds B UP to a minimum before bucketing: at small B the buckets
+    step by 1, so a fleet of control loops whose coalesced sizes
+    wander 1..N would compile one program per size — flooring them
+    onto one shared program trades a few inert pad elements for a
+    bounded compiled-program count (docs/FLEET.md)."""
     b_real = fn_args[0].shape[0]
-    b_target = bucket_size(b_real)
+    b_target = bucket_size(max(b_real, batch_floor))
     ent = "fleet.warm" if warm else "fleet.cold"
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
@@ -506,6 +512,7 @@ def solve_fleet(
     record: bool = True,
     recorder=None,
     trace_ids: Optional[dict] = None,
+    batch_floor: int = 1,
 ) -> list[FleetResult]:
     """Solve every tenant, batched by bucket class: one device dispatch
     per (class, warm/cold) instead of one per tenant.
@@ -602,7 +609,7 @@ def solve_fleet(
                                           for i in warm_idx])):
                 out_b, used_b, ok_b = _dispatch(
                     stacked, mesh, True, k, max_iterations, mode, rec,
-                    record)
+                    record, batch_floor=batch_floor)
             if record:
                 rec.observe("fleet.dispatch_s", rec.now() - t0)
                 rec.count("fleet.batches")
@@ -648,7 +655,7 @@ def solve_fleet(
                                           for i in cold_idx])):
                 out_b, sweeps_b, used_b = _dispatch(
                     stacked, mesh, False, k, max_iterations, mode, rec,
-                    record)
+                    record, batch_floor=batch_floor)
             if record:
                 rec.observe("fleet.dispatch_s", rec.now() - t0)
                 rec.count("fleet.batches")

@@ -33,11 +33,17 @@ Design points:
   ``PlannerSession`` doing the same (the cache consume/store lifecycle
   is the session's, value-matched because service callers rebuild
   arrays per request).
+- **Admission fairness**: ``fair_share`` bounds one tenant's share of
+  a coalescing window; over-quota requests roll to the next batch
+  (oldest first) and count ``fleet.starved_admissions`` — a chatty
+  tenant cannot starve its neighbors' converge cycles (docs/FLEET.md
+  "Fleet of control loops").
 - **Shared state** (analysis/race_lint.py SHARED_STATE): ``_closed``,
-  ``_task`` and the queue are touched by ``submit``/``stop`` (the
-  app-facing surface) and the dispatcher task; every mutation sits in
-  a single no-await window, and the carry cache is written ONLY from
-  the dispatcher task, so cache state cannot interleave mid-batch.
+  ``_task``, the queue and the ``_deferred`` carry-over list are
+  touched by ``submit``/``stop`` (the app-facing surface) and the
+  dispatcher task; every mutation sits in a single no-await window,
+  and the carry cache is written ONLY from the dispatcher task, so
+  cache state cannot interleave mid-batch.
 """
 
 from __future__ import annotations
@@ -98,6 +104,23 @@ class PlanService:
         service owns one bounded to ``carry_bytes`` and
         ``carry_entries`` keys (churning tenant keys must not grow the
         entry table forever).
+    fair_share: bounded per-tenant share of one coalescing window — at
+        most this many requests per tenant key land in a batch; the
+        excess rolls to the NEXT batch (admitted first there, oldest
+        first, quota applied again).  Cross-tenant admission fairness
+        for the fleet-of-loops tier: a chatty tenant churning deltas
+        cannot fill a window and starve its neighbors' converge
+        cycles.  Every deferral counts ``fleet.starved_admissions`` so
+        starvation is observable, and deferral never changes a result —
+        the deferred request solves in a later batch with the same
+        inputs (docs/FLEET.md).  None (default) disables the quota.
+    batch_floor: pad every dispatch's batch axis up to at least this
+        many elements before bucketing.  Small coalesced batches wander
+        ``B = 1..N`` where the batch buckets step by 1, so a fleet of
+        control loops would compile one program per size; the floor
+        trades a few inert pad elements for ONE compiled program per
+        bucket class (the fleet controller defaults it to 16; 1 here =
+        the exact pre-floor behavior).
     """
 
     def __init__(
@@ -113,11 +136,22 @@ class PlanService:
         max_iterations: int = 10,
         recorder: Optional["Recorder"] = None,
         inline_solve: bool = False,
+        fair_share: Optional[int] = None,
+        batch_floor: int = 1,
     ) -> None:
         if max_pending <= 0 or max_batch <= 0:
             raise ValueError("max_pending and max_batch must be positive")
+        if fair_share is not None and fair_share < 1:
+            raise ValueError(f"fair_share must be >= 1, got {fair_share}")
         self.admission_window_s = float(admission_window_s)
         self.max_batch = int(max_batch)
+        self.fair_share = fair_share
+        # Pad every dispatch's batch axis up to at least this many
+        # elements before bucketing (plan/fleet.py _dispatch): a fleet
+        # of control loops whose coalesced sizes wander 1..N trades a
+        # few inert pad elements for ONE compiled program per class
+        # instead of one per batch size (docs/FLEET.md).
+        self.batch_floor = int(batch_floor)
         self.mesh = mesh
         self.max_iterations = int(max_iterations)
         # inline_solve runs the fleet batch on the dispatcher coroutine
@@ -132,9 +166,14 @@ class PlanService:
         self._trace_ids = TraceIdSource()
         self.carry_cache = carry_cache if carry_cache is not None \
             else CarryCache(max_bytes=carry_bytes,
-                            max_entries=carry_entries)
+                            max_entries=carry_entries,
+                            recorder=self._rec)
         self._queue: "asyncio.Queue[object]" = \
             asyncio.Queue(maxsize=max_pending)
+        # Over-quota requests rolled out of a coalescing window by the
+        # fairness bound; dispatcher-task-owned (admitted, oldest
+        # first, at the head of the next window).
+        self._deferred: list[_Request] = []
         self._task: Optional["asyncio.Task[None]"] = None
         self._closed = False
         self._executor: Optional[ThreadPoolExecutor] = None
@@ -187,6 +226,11 @@ class PlanService:
         closed-check may drain concurrently with stop(), and stealing
         the sentinel would strand stop() awaiting a dispatcher that
         never sees it."""
+        deferred, self._deferred = self._deferred, []
+        for req in deferred:
+            if not req.future.done():
+                req.future.set_exception(
+                    PlanServiceClosed("PlanService stopped"))
         stops = 0
         while True:
             try:
@@ -266,13 +310,43 @@ class PlanService:
 
     # -- the dispatcher task -------------------------------------------------
 
+    def _over_quota(self, key: str, counts: dict[str, int]) -> bool:
+        return self.fair_share is not None and \
+            counts.get(key, 0) >= self.fair_share
+
+    def _defer(self, req: _Request) -> None:
+        """Roll one over-quota request to the next window (sync window;
+        the starved counter is the starvation observable — one count
+        per deferral event, so a request stuck behind a chatty tenant
+        for several windows counts several times)."""
+        self._deferred.append(req)
+        self._rec.count("fleet.starved_admissions")
+
     async def _admit_batch(self, first: _Request) -> tuple[
             list[_Request], bool]:
-        """Coalesce requests for one fleet batch: everything already
-        queued plus whatever arrives within the admission window.
-        Returns (batch, stop_seen)."""
+        """Coalesce requests for one fleet batch: deferred carry-overs
+        from prior windows first (oldest first), then everything
+        already queued plus whatever arrives within the admission
+        window — each admission subject to the per-tenant
+        ``fair_share`` quota.  Returns (batch, stop_seen)."""
         loop = asyncio.get_running_loop()
         batch = [first]
+        counts = {first.problem.key: 1}
+        carried, self._deferred = self._deferred, []
+        for i, req in enumerate(carried):
+            if len(batch) >= self.max_batch:
+                # Plain capacity pressure, not starvation: the rest of
+                # the carry-overs roll forward WITHOUT counting the
+                # starved metric (it measures fair-share deferrals
+                # only — docs/OBSERVABILITY.md).
+                self._deferred.extend(carried[i:])
+                break
+            if self._over_quota(req.problem.key, counts):
+                self._defer(req)
+            else:
+                counts[req.problem.key] = \
+                    counts.get(req.problem.key, 0) + 1
+                batch.append(req)
         deadline = loop.time() + self.admission_window_s
         while len(batch) < self.max_batch:
             timeout = deadline - loop.time()
@@ -292,6 +366,10 @@ class PlanService:
             assert isinstance(nxt, _Request)
             if nxt.timeline is not None:
                 nxt.timeline.mark("admission", self._rec.now())
+            if self._over_quota(nxt.problem.key, counts):
+                self._defer(nxt)
+                continue
+            counts[nxt.problem.key] = counts.get(nxt.problem.key, 0) + 1
             batch.append(nxt)
         return batch, False
 
@@ -330,17 +408,27 @@ class PlanService:
         results = solve_fleet(
             problems, mesh=self.mesh,
             max_iterations=self.max_iterations, recorder=rec,
-            trace_ids=trace_ids)
+            trace_ids=trace_ids, batch_floor=self.batch_floor)
         return t_start, rec.now(), results
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         rec = self._rec
         while True:
-            first = await self._queue.get()
-            if first is _STOP:
-                return
-            assert isinstance(first, _Request)
+            if self._deferred:
+                # Deferred carry-overs open the next window immediately
+                # — a starved tenant must not additionally wait for
+                # fresh traffic.  (Their "admission" mark was stamped
+                # at the original dequeue.)
+                first = self._deferred.pop(0)
+            else:
+                nxt = await self._queue.get()
+                if nxt is _STOP:
+                    return
+                assert isinstance(nxt, _Request)
+                first = nxt
+                if first.timeline is not None:
+                    first.timeline.mark("admission", rec.now())
             if self._closed:
                 # Second exit (belt for a lost stop sentinel): a closed
                 # service must never process new batches; stop()'s
@@ -349,8 +437,6 @@ class PlanService:
                     first.future.set_exception(
                         PlanServiceClosed("PlanService stopped"))
                 return
-            if first.timeline is not None:
-                first.timeline.mark("admission", rec.now())
             batch = [first]
             stop_seen = False
             # EVERY admitted request's future resolves inside this try:

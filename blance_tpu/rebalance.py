@@ -32,6 +32,7 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Iterable, Mapping, Optional
 
+from .control import CycleEngine, CyclePlanner
 from .core.types import (
     Partition,
     PartitionMap,
@@ -530,7 +531,7 @@ def _maps_equal(a: PartitionMap, b: PartitionMap) -> bool:
     return norm(a) == norm(b)
 
 
-class RebalanceController:
+class RebalanceController(CycleEngine):
     """The continuous-rebalance control loop (ROADMAP item 4).
 
     ``rebalance_async`` is one bounded episode; production is a loop:
@@ -557,6 +558,19 @@ class RebalanceController:
       ``rebalance.unconverged`` and leaves the residue for the next
       delta.
 
+    The generic debounce/coalesce/converge machinery is the extracted
+    :class:`~blance_tpu.control.CycleEngine` (the fleet tier runs one
+    engine per tenant on a single event loop, docs/FLEET.md); this
+    class supplies the cluster-specific half: planning, orchestration,
+    supersede, health and SLO accounting.  A
+    :class:`~blance_tpu.control.CyclePlanner` (``planner=``) replaces
+    the inline planning step with an AWAITED one — the seam that lets N
+    controllers coalesce their converge cycles through one shared
+    ``plan.service.PlanService`` fleet dispatch.  The planner path
+    bypasses the session (mutually exclusive) and is itself bypassed by
+    graceful degradation (capacity shed / empty candidate set), which
+    stays on the local planner exactly like the session path.
+
     Single-task discipline (analysis/race_lint.py ``SHARED_STATE``):
     every mutation of the shared control state happens in a sync
     window, either on the app-facing surface (``submit``/``stop_soon``)
@@ -569,6 +583,8 @@ class RebalanceController:
     replays a week of cluster life in seconds, bit-identically).
     """
 
+    TASK_NAME = "rebalance-controller"
+
     def __init__(
         self,
         model: PartitionModel,
@@ -580,15 +596,23 @@ class RebalanceController:
         orchestrator_options: Optional[OrchestratorOptions] = None,
         backend: str = "greedy",
         session: "Optional[PlannerSession]" = None,
+        planner: Optional[CyclePlanner] = None,
         find_move: Optional[FindMoveFunc] = None,
         debounce_s: float = 0.05,
         max_passes_per_cycle: int = 8,
         slo: Optional[SloTracker] = None,
         move_observers: tuple = (),
     ) -> None:
+        if session is not None and planner is not None:
+            raise ValueError(
+                "session and planner are mutually exclusive: the async "
+                "planner path owns its own warm-carry lifecycle (the "
+                "plan service's CarryCache), so a session's carry would "
+                "never be consulted")
         self.model = model
         self._assign = assign_partitions
         self._find_move = find_move
+        self._planner = planner
         # Private copy: the controller folds weight deltas into its
         # options view, and mutating a caller-shared PlanOptions would
         # leak this loop's weights into unrelated plans.
@@ -597,9 +621,9 @@ class RebalanceController:
         self.orch_opts = orchestrator_options or OrchestratorOptions()
         self.backend = backend
         self.session = session
-        self.debounce_s = debounce_s
         self.max_passes_per_cycle = max(int(max_passes_per_cycle), 1)
         self._rec = get_recorder()
+        super().__init__(debounce_s=debounce_s, clock=self._rec.now)
         self.current: PartitionMap = copy_partition_map(current_map)
         self._nodes: list[str] = list(nodes_all)
         self._removing: set[str] = set()  # graceful decommissions
@@ -624,69 +648,47 @@ class RebalanceController:
         if self._slo is not None and self.health is not None:
             self._slo.attach_health(self.health)
 
-        self._pending: list[ClusterDelta] = []
-        self._wake = asyncio.Event()
-        self._idle = asyncio.Event()
-        self._idle.set()
         self._inflight: Optional[Orchestrator] = None
-        self._stopping = False
-        self._task: "Optional[asyncio.Task[object]]" = None
         # Introspection / scoring surface:
         self.warnings: dict[str, list[str]] = {}
         self.failures: list[MoveFailure] = []
         self.degraded_reports: list[DegradedPlacement] = []
-        self.cycles = 0
         self.passes = 0
         self.superseded = 0
         self.unconverged_cycles = 0
-        # Called with the recorder-clock time whenever the controller
-        # returns to idle (no pending deltas, nothing in flight) — the
-        # simulator's per-incident convergence-lag hook.
-        self.on_quiesce: list[Callable[[float], None]] = []
         # Called with (nodes, t) whenever placements are stripped (an
         # abrupt fail delta, or quarantined placements presumed lost) —
         # the simulator's event log needs every strip to make the SLO
         # account recomputable from the log alone.
         self.on_strip: list[Callable[[set[str], float], None]] = []
 
-    # -- app-facing control surface (sync: single atomic windows) ---------
+    # -- CycleEngine hooks (sync: single atomic windows) -------------------
 
-    def submit(self, delta: ClusterDelta) -> None:
-        """Enqueue a cluster delta; coalesces with everything else that
-        arrives within the debounce window.  Sync and re-entrant from
-        progress callbacks."""
-        self._pending.append(delta)
+    def _on_submit(self, delta: ClusterDelta) -> None:
         self._rec.count("sim.deltas")
         if self._slo is not None:
             # One busy episode = one SLO incident (first submit wins;
             # the next quiesce closes it with the time-to-last-required
             # -move sample, slo.first_converged_lag_s).
             self._slo.open_incident(self._rec.now())
-        self._idle.clear()
-        self._wake.set()
 
-    def stop_soon(self) -> None:
-        """Request wind-down: cancels any in-flight transition and lets
-        the controller task exit.  Sync; pair with ``await stop()`` (or
-        await the start() task) for the rendezvous."""
-        self._stopping = True
-        self._wake.set()
+    def _on_stop_soon(self) -> None:
+        # Wind-down cancels any in-flight transition.
         o = self._inflight
         if o is not None:
             o.cancel()
 
-    def start(self) -> "asyncio.Task[object]":
-        """Spawn the controller task (requires a running loop)."""
-        if self._task is None:
-            self._task = asyncio.ensure_future(self._run())
-            self._task.set_name("rebalance-controller")
-        return self._task
+    def _on_idle(self, t: float) -> None:
+        if self._slo is not None:
+            self._slo.close_incident(t)
 
-    async def stop(self) -> None:
-        """stop_soon + await the controller task's exit."""
-        self.stop_soon()
-        if self._task is not None:
-            await self._task
+    def _on_exit(self) -> None:
+        if self._slo is not None and not self._idle.is_set():
+            # A crash / mid-episode stop is not a quiesce: the open
+            # incident dies unrecorded (same discard-on-raise rule as
+            # rebalance_async) instead of closing as an "instantly
+            # converged" 0.0 lag sample.
+            self._slo.discard_incident()
 
     async def quiesce(self) -> PartitionMap:
         """Wait until the controller is idle (every submitted delta
@@ -715,47 +717,6 @@ class RebalanceController:
         if o is not None:
             out.extend(o.pending_tasks())
         return out
-
-    # -- the loop ----------------------------------------------------------
-
-    async def _run(self) -> None:
-        try:
-            while not self._stopping:
-                if not self._pending:
-                    self._set_idle()
-                    await self._wake.wait()
-                    continue
-                if self.debounce_s > 0:
-                    # Coalesce the burst: everything that lands during
-                    # this (virtual-time) window joins the cycle.
-                    await asyncio.sleep(self.debounce_s)
-                deltas = self._take_pending()
-                if deltas:
-                    self._apply_deltas(deltas)
-                    self.cycles += 1
-                    await self._converge()
-        finally:
-            if self._slo is not None and not self._idle.is_set():
-                # A crash / mid-episode stop is not a quiesce: the open
-                # incident dies unrecorded (same discard-on-raise rule
-                # as rebalance_async) instead of closing as an
-                # "instantly converged" 0.0 lag sample.
-                self._slo.discard_incident()
-            self._set_idle()
-
-    def _take_pending(self) -> list[ClusterDelta]:
-        taken, self._pending = self._pending, []
-        self._wake.clear()
-        return taken
-
-    def _set_idle(self) -> None:
-        if not self._idle.is_set():
-            self._idle.set()
-            t = self._rec.now()
-            if self._slo is not None:
-                self._slo.close_incident(t)
-            for hook in self.on_quiesce:
-                hook(t)
 
     def _apply_deltas(self, deltas: Iterable[ClusterDelta]) -> None:
         """Fold deltas into the membership/weight view, IN ORDER (a
@@ -917,6 +878,25 @@ class RebalanceController:
         session.replan()
         return session.to_map("proposed")
 
+    async def _plan_cycle(self, candidates: list[str]) \
+            -> tuple[Optional[PartitionMap], Optional[DegradedPlacement]]:
+        """One planning step, through the async ``planner`` seam when
+        one is wired and the cycle is healthy.  Graceful degradation
+        (empty candidate set, capacity shed) bypasses the planner onto
+        the local path, exactly like it bypasses a session — the
+        planner's encoded statics pin the full constraint set."""
+        if self._planner is not None and candidates and \
+                self._shed_plan(len(candidates))[0] is None:
+            removes = sorted(self._removing | self._failed |
+                             set(self.quarantined_nodes()))
+            next_map, warns = await self._planner.plan_cycle(
+                self.current, list(self._nodes), removes, self.model,
+                self.opts)
+            for k, v in warns.items():
+                self.warnings.setdefault(k, []).extend(v)
+            return next_map, None
+        return self._plan(candidates)
+
     # -- one converge cycle -------------------------------------------------
 
     async def _converge(self) -> None:
@@ -924,7 +904,7 @@ class RebalanceController:
         a new delta supersedes the cycle, or the pass budget runs out."""
         passes = 0
         while not self._stopping:
-            next_map, report = self._plan(self._candidates())
+            next_map, report = await self._plan_cycle(self._candidates())
             if report is not None:
                 self.degraded_reports.append(report)
                 self._rec.count("sim.degraded_plans")
@@ -1002,9 +982,6 @@ class RebalanceController:
         await drain
         self._adopt(o)
         return superseded, o.move_failures()
-
-    async def _wake_wait(self) -> None:
-        await self._wake.wait()
 
     async def _drain_progress(self, o: Orchestrator) -> None:
         async for _progress in o.progress_ch():

@@ -41,6 +41,14 @@ __all__ = [
     "hetero_drain",
     "mixed_week",
     "SCENARIOS",
+    "FleetTenant",
+    "FleetEvent",
+    "FleetScenario",
+    "fleet_zone_outage",
+    "fleet_onboarding",
+    "fleet_noisy_neighbor",
+    "fleet_week",
+    "FLEET_SCENARIOS",
 ]
 
 
@@ -363,4 +371,249 @@ SCENARIOS: dict[str, Callable[[int], SimScenario]] = {
     "weight_drift": weight_drift,
     "hetero_drain": hetero_drain,
     "mixed_week": mixed_week,
+}
+
+
+# -- multi-tenant fleet scenarios (blance_tpu/fleetloop.py) -------------------
+#
+# A FleetScenario scripts N tenant indexes over ONE shared node fleet —
+# the cbgt/FTS production shape.  Events either fan to every onboarded
+# tenant (tenants=(): correlated membership changes — a zone outage is
+# ONE event hitting all loops at once) or target specific tenants
+# (per-tenant weight drift: the noisy neighbor).  Tenants with
+# onboard_t > 0 join mid-run with EMPTY placements and converge from
+# nothing (staggered onboarding).  testing/fleetsim.py executes a
+# scenario under the DeterministicLoop; the same seed replays the whole
+# fleet's week bit-identically (docs/SIMULATOR.md "Multi-tenant
+# scenario families").
+
+
+@dataclass(frozen=True)
+class FleetTenant:
+    """One tenant index in a fleet scenario.  ``onboard_t == 0`` means
+    present from the start with round-robin seed placements; ``> 0``
+    means the tenant onboards mid-run with empty placements and its
+    first converge cycle places everything."""
+
+    key: str
+    partitions: int
+    replicas: int = 1
+    onboard_t: float = 0.0
+
+
+@dataclass(frozen=True)
+class FleetEvent:
+    """One timed delta in a fleet trace.  ``tenants == ()`` fans the
+    delta to every onboarded tenant (correlated membership events);
+    otherwise it targets exactly the named tenants (weight drift)."""
+
+    t: float
+    delta: ClusterDelta
+    tenants: tuple[str, ...] = ()
+    label: str = ""
+    outage: bool = False
+
+
+@dataclass(frozen=True)
+class FleetScenario:
+    """A complete multi-tenant simulator run (module comment above)."""
+
+    name: str
+    seed: int
+    horizon_s: float
+    nodes: tuple[str, ...]
+    tenants: tuple[FleetTenant, ...]
+    events: tuple[FleetEvent, ...] = ()
+    availability_floor: float = 0.85
+    # Virtual per-batch data-plane latency (shared by every tenant).
+    base_latency_s: float = 2.0
+    node_latency_s: Mapping[str, float] = field(default_factory=dict)
+    # Control-loop + plan-service knobs.
+    debounce_s: float = 1.0
+    admission_window_s: float = 0.25
+    fair_share: "int | None" = None
+    carry_bytes: "int | None" = None  # None = unbounded (identity runs)
+    carry_entries: "int | None" = None
+    max_passes_per_cycle: int = 8
+    max_steps: int = 20_000_000
+
+
+def _fleet_tenants(rng: random.Random, n: int,
+                   choices: "tuple[int, ...]",
+                   onboard: Callable[[int], float]) -> tuple[
+                       FleetTenant, ...]:
+    """Tenant specs with partition counts drawn from a SMALL choice
+    set: at cbgt-index sizes the shape buckets step finely, so free
+    size choice would give nearly every tenant its own compiled
+    program — a handful of bucket-exact sizes keeps the whole fleet on
+    a couple of shared programs (the GSPMD-bucketing point)."""
+    return tuple(
+        FleetTenant(key=f"t{i:03d}",
+                    partitions=rng.choice(choices),
+                    replicas=1, onboard_t=onboard(i))
+        for i in range(n))
+
+
+def fleet_zone_outage(seed: int = 5, tenants: int = 8) -> FleetScenario:
+    """Correlated zone outage: one zone's nodes fail for EVERY tenant
+    at once — N coalesced converge cycles through a handful of fleet
+    dispatches — then return; two tenants heat up afterwards."""
+    rng = random.Random(f"fzone:{seed}:{tenants}")
+    nodes = _zone_nodes(3, 4)
+    z1 = tuple(n for n in nodes if n.startswith("z1"))
+    ts = _fleet_tenants(rng, tenants, (12, 16), lambda i: 0.0)
+    hot = sorted(rng.sample([t.key for t in ts], min(2, tenants)))
+    t_down = _jitter(rng, 600, 30)
+    events = [
+        FleetEvent(t=t_down, delta=ClusterDelta(fail=z1),
+                   label="zone-z1-outage", outage=True),
+        FleetEvent(t=_jitter(rng, t_down + 1200, 30),
+                   delta=ClusterDelta(add=z1),
+                   label="zone-z1-returns"),
+    ]
+    for i, key in enumerate(hot):
+        events.append(FleetEvent(
+            t=_jitter(rng, 2400 + 120 * i, 20),
+            delta=ClusterDelta(partition_weights={"p0000": 8, "p0001": 8}),
+            tenants=(key,), label=f"hot-tenant-{key}"))
+    events.sort(key=lambda e: (e.t, e.label))
+    return FleetScenario(
+        name="fleet_zone_outage", seed=seed, horizon_s=3600.0,
+        nodes=nodes, tenants=ts, events=tuple(events),
+        availability_floor=0.5)
+
+
+def fleet_onboarding(seed: int = 13, tenants: int = 12) -> FleetScenario:
+    """Staggered tenant onboarding: a third of the fleet is live at t0,
+    the rest join over the first half of the horizon (each converging
+    from empty placements), then one graceful node retirement drains
+    across every live tenant."""
+    rng = random.Random(f"fonboard:{seed}:{tenants}")
+    # Same node fleet + size choices as fleet_zone_outage: every smoke
+    # family shares the same two compiled bucket classes.
+    nodes = _zone_nodes(3, 4)
+    head = max(tenants // 3, 1)
+
+    def onboard(i: int) -> float:
+        if i < head:
+            return 0.0
+        return _jitter(rng, 300 + (i - head) * (1500 / max(
+            tenants - head, 1)), 20)
+
+    ts = _fleet_tenants(rng, tenants, (12, 16), onboard)
+    retire = rng.choice(sorted(nodes))
+    events = (
+        FleetEvent(t=_jitter(rng, 2600, 30),
+                   delta=ClusterDelta(remove=(retire,)),
+                   label=f"graceful-retire-{retire}"),
+    )
+    return FleetScenario(
+        name="fleet_onboarding", seed=seed, horizon_s=3600.0,
+        nodes=nodes, tenants=ts, events=events,
+        availability_floor=0.85)
+
+
+def fleet_noisy_neighbor(seed: int = 29,
+                         tenants: int = 10) -> FleetScenario:
+    """Noisy-neighbor churn: one chatty tenant submits a weight-drift
+    delta every few virtual seconds for a long stretch while its
+    neighbors ride out a node fail/return — with ``fair_share`` set,
+    the chatty tenant cannot fill the coalescing windows
+    (``fleet.starved_admissions`` counts its deferrals) and the
+    neighbors' converge cycles stay prompt."""
+    rng = random.Random(f"fnoisy:{seed}:{tenants}")
+    # Same node fleet + size choices as fleet_zone_outage (shared
+    # compiled classes across the smoke families).
+    nodes = _zone_nodes(3, 4)
+    ts = _fleet_tenants(rng, tenants, (12, 16), lambda i: 0.0)
+    noisy = ts[0].key
+    events: list[FleetEvent] = []
+    t = 200.0
+    for wave in range(24):
+        p = rng.randrange(ts[0].partitions)
+        events.append(FleetEvent(
+            t=round(t, 3),
+            delta=ClusterDelta(
+                partition_weights={f"p{p:04d}": rng.choice([1, 4, 8])}),
+            tenants=(noisy,), label=f"noisy-wave-{wave:02d}"))
+        t += rng.uniform(8.0, 20.0)
+    victim = nodes[-1]
+    events.append(FleetEvent(
+        t=_jitter(rng, 900, 20), delta=ClusterDelta(fail=(victim,)),
+        label=f"fail-{victim}", outage=True))
+    events.append(FleetEvent(
+        t=_jitter(rng, 1800, 20), delta=ClusterDelta(add=(victim,)),
+        label=f"return-{victim}"))
+    events.sort(key=lambda e: (e.t, e.label))
+    return FleetScenario(
+        name="fleet_noisy_neighbor", seed=seed, horizon_s=2700.0,
+        nodes=nodes, tenants=ts, events=tuple(events),
+        availability_floor=0.5, fair_share=2,
+        admission_window_s=0.5)
+
+
+def fleet_week(seed: int = 3, tenants: int = 240,
+               days: float = 7.0) -> FleetScenario:
+    """The fleet soak: a multi-hundred-tenant virtual week mixing every
+    family — staggered onboarding over the first two days, a
+    correlated zone outage on day 3 hitting ALL tenants at once, a
+    two-node spot burst on day 5, and rotating noisy-neighbor weight
+    waves throughout.  Replays bit-identically under the
+    DeterministicLoop (the ISSUE 13 acceptance scenario)."""
+    rng = random.Random(f"fweek:{seed}:{tenants}")
+    nodes = _zone_nodes(3, 6)  # 18 nodes
+    day = 86_400.0
+    horizon = days * day
+    head = max(tenants // 4, 1)
+
+    def onboard(i: int) -> float:
+        if i < head:
+            return 0.0
+        return _jitter(rng, 0.1 * day + (i - head) * (1.9 * day / max(
+            tenants - head, 1)), 600)
+
+    ts = _fleet_tenants(rng, tenants, (8, 12), onboard)
+    events: list[FleetEvent] = []
+    # Day 3: correlated zone outage (one event, every tenant's loop).
+    z2 = tuple(n for n in nodes if n.startswith("z2"))
+    t_down = _jitter(rng, 3.0 * day, 3600)
+    events.append(FleetEvent(t=t_down, delta=ClusterDelta(fail=z2),
+                             label="zone-z2-outage", outage=True))
+    events.append(FleetEvent(t=_jitter(rng, t_down + 0.1 * day, 600),
+                             delta=ClusterDelta(add=z2),
+                             label="zone-z2-returns"))
+    # Day 5: spot burst (two survivors of z0).
+    victims = tuple(sorted(rng.sample(
+        [n for n in nodes if n.startswith("z0")], 2)))
+    t_kill = _jitter(rng, 5.0 * day, 3600)
+    events.append(FleetEvent(t=t_kill, delta=ClusterDelta(fail=victims),
+                             label="spot-burst", outage=True))
+    events.append(FleetEvent(t=_jitter(rng, t_kill + 0.05 * day, 600),
+                             delta=ClusterDelta(add=victims),
+                             label="spot-burst-returns"))
+    # Rotating noisy neighbors: every half-day, one tenant heats up.
+    for w in range(int(days * 2)):
+        key = rng.choice([t.key for t in ts[:head]])
+        p = rng.randrange(6)
+        events.append(FleetEvent(
+            t=_jitter(rng, (w + 0.6) * 0.5 * day, 1800),
+            delta=ClusterDelta(
+                partition_weights={f"p{p:04d}": rng.choice([1, 4, 8])}),
+            tenants=(key,), label=f"hot-wave-{w:02d}-{key}"))
+    events.sort(key=lambda e: (e.t, e.label))
+    return FleetScenario(
+        name="fleet_week", seed=seed, horizon_s=horizon,
+        nodes=nodes, tenants=ts, events=tuple(events),
+        availability_floor=0.5, fair_share=4,
+        max_steps=200_000_000)
+
+
+# Fleet scenario-family registry: name -> builder(seed, tenants).  The
+# CI fleet-sim smoke crosses fixed seeds with small tenant-scale
+# points; fleet_week at multi-hundred tenants is the slow-marked soak.
+FLEET_SCENARIOS: dict[str, Callable[..., FleetScenario]] = {
+    "fleet_zone_outage": fleet_zone_outage,
+    "fleet_onboarding": fleet_onboarding,
+    "fleet_noisy_neighbor": fleet_noisy_neighbor,
+    "fleet_week": fleet_week,
 }

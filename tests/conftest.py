@@ -61,6 +61,34 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.smoke)
 
 
+_EXIT_STATUS = [0]
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    """Skip interpreter teardown after the summary is written.
+
+    A full tier-1 process accumulates thousands of compiled XLA
+    executables; their destructor cascade (plus the final GC of the
+    multi-GB object graph) burns tens of seconds AFTER the last test —
+    wall-clock the CI/driver timeout still charges to the suite, with
+    zero verification value.  Once pytest has printed its terminal
+    summary (unconfigure runs after the sessionfinish wrapper's tail),
+    hard-exit with pytest's own status.  Set BLANCE_FAST_EXIT=0 to
+    keep normal teardown (e.g. when profiling shutdown or running
+    under coverage tools that finalize at exit)."""
+    if os.environ.get("BLANCE_FAST_EXIT", "1") == "0":
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_EXIT_STATUS[0])
+
+
 # -- static-contract fixtures (docs/STATIC_ANALYSIS.md) ---------------------
 
 # Transfer-guard allowlist contract: the pure solver paths convert at the

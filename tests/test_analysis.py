@@ -597,6 +597,9 @@ def test_race_lint_real_package_model_matches_reality():
     import blance_tpu.plan.service as planservice
     from blance_tpu.analysis.race_lint import SHARED_STATE
 
+    import blance_tpu.control as control
+    import blance_tpu.fleetloop as fleetloop
+
     # `import blance_tpu.rebalance as ...` would resolve to the
     # same-named FUNCTION the package re-exports, not the module.
     rebalance = importlib.import_module("blance_tpu.rebalance")
@@ -604,6 +607,9 @@ def test_race_lint_real_package_model_matches_reality():
     import inspect
 
     sources = {
+        "CycleEngine": inspect.getsource(control.CycleEngine),
+        "FleetController": inspect.getsource(fleetloop.FleetController),
+        "FleetSloRollup": inspect.getsource(slo.FleetSloRollup),
         "Orchestrator": inspect.getsource(orch.Orchestrator),
         "OrchestratorProgress": inspect.getsource(
             orch.OrchestratorProgress),
