@@ -108,6 +108,16 @@ SHARED_STATE: dict[str, frozenset[str]] = {
                               "_deferred"}),
     "CarryCache": frozenset({"_entries", "_clock", "_bytes",
                              "evictions"}),
+    # EncodeCache (ISSUE 14, plan/carry.py) is shared by N tenant
+    # control-loop tasks.  Discipline: every method is synchronous (one
+    # no-await window) and each KEY has a single writer — its tenant's
+    # own task; cross-key interference is limited to LRU eviction,
+    # which only ever costs the evicted key a cold re-encode.  A
+    # planner holds its EncodedState object across its solve await, so
+    # a concurrent eviction drops only the cache's reference; the
+    # owner's next put re-inserts and re-enforces the budget.
+    "EncodeCache": frozenset({"_entries", "_ticks", "_clock",
+                              "evictions", "demotions"}),
     # -- converge-cycle engine + continuous-rebalance controller
     # (PR 10; engine extracted to blance_tpu/control.py in ISSUE 13) ---------
     # The CycleEngine's control state is touched by the app-facing

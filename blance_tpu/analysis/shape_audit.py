@@ -958,6 +958,79 @@ def _check_sparse_fallback() -> list[Finding]:
     return findings
 
 
+def _check_encode_residency() -> list[Finding]:
+    """Concrete host contract of the encode-residency delta kernels
+    (plan/resident.py, ISSUE 14): strip_prev_rows must equal
+    strip-the-map-then-re-encode bit-exactly (new array, untouched rows
+    byte-identical), and pack_slot_rows must be the decode pack —
+    non-negative prefix in original slot order with exact counts.
+    Tiny problem, host-only, milliseconds."""
+    import numpy as np
+
+    findings: list[Finding] = []
+    label = "encode_residency"
+    try:
+        from ..core.encode import (
+            encode_problem,
+            pack_slot_rows,
+            strip_prev_rows,
+        )
+        from ..core.types import Partition, PartitionModelState, PlanOptions
+
+        model = {
+            "primary": PartitionModelState(priority=0, constraints=1),
+            "replica": PartitionModelState(priority=1, constraints=2),
+        }
+        nodes = [f"n{i}" for i in range(5)]
+        pmap = {
+            f"{i:02d}": Partition(f"{i:02d}", {
+                "primary": [nodes[i % 5]],
+                "replica": [nodes[(i + 1) % 5], nodes[(i + 2) % 5]]})
+            for i in range(7)
+        }
+        problem = encode_problem(pmap, pmap, nodes, [], model,
+                                 PlanOptions())
+        dark = {"n1"}
+        ids = np.array([1], np.int32)
+        patched, dirty = strip_prev_rows(problem.prev, ids)
+        stripped = {
+            name: Partition(name, {
+                s: [n for n in ns if n not in dark]
+                for s, ns in p.nodes_by_state.items()})
+            for name, p in pmap.items()}
+        want = encode_problem(stripped, stripped, nodes, sorted(dark),
+                              model, PlanOptions())
+        if patched.shape != want.prev.shape or \
+                not np.array_equal(patched, want.prev):
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message="strip_prev_rows != strip-map-then-re-encode"))
+        if patched is problem.prev:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message="strip_prev_rows returned the input array — "
+                        "identity memos would serve stale hits"))
+        if dirty.shape != (problem.P,) or not dirty.any():
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message="strip_prev_rows dirty mask drifted"))
+        rows = np.array([[[2, -1, 0], [-1, -1, 4]]], np.int32)
+        packed, counts = pack_slot_rows(rows)
+        if packed.tolist() != [[[2, 0, -1], [4, -1, -1]]] or \
+                counts.tolist() != [[2, 1]]:
+            findings.append(Finding(
+                rule="SHP003", path=_PATH, line=0, symbol=label,
+                message=f"pack_slot_rows drifted: {packed.tolist()} "
+                        f"{counts.tolist()}"))
+    except Exception as e:
+        first = (str(e).splitlines() or [""])[0][:200]
+        findings.append(Finding(
+            rule="SHP002", path=_PATH, line=0, symbol=label,
+            message=f"encode-residency audit raised "
+                    f"({type(e).__name__}: {first})"))
+    return findings
+
+
 def run_shape_audit() -> tuple[list[Finding], int]:
     """Run the whole table.  Returns (findings, entries_checked)."""
     findings: list[Finding] = []
@@ -966,4 +1039,5 @@ def run_shape_audit() -> tuple[list[Finding], int]:
     findings.extend(_check_encode_decode())
     findings.extend(_check_bucketing_algebra())
     findings.extend(_check_sparse_fallback())
-    return findings, len(CONTRACTS) + 3
+    findings.extend(_check_encode_residency())
+    return findings, len(CONTRACTS) + 4

@@ -49,7 +49,27 @@ class CyclePlanner(Protocol):
     options — and returns ``(next_map, warnings)`` exactly like
     ``plan.api.plan_next_map``.  Because it is awaited, N controllers
     sharing one :class:`~blance_tpu.plan.service.PlanService`-backed
-    planner coalesce their cycles into shared fleet dispatches."""
+    planner coalesce their cycles into shared fleet dispatches.
+
+    **Optional residency hooks** (duck-typed — the controller calls
+    them via ``getattr`` so plain planners need not define them): a
+    planner that keeps *resident encoded state* between cycles
+    (``fleetloop.ServicePlanner`` with encode residency,
+    docs/DESIGN.md "Encode residency") can implement
+
+    - ``notify_strip(nodes, before, after)`` — called in the same sync
+      window an abrupt-fail delta replaced the controller's current
+      map (``before`` → ``after``, dark placements stripped), so the
+      planner can patch its resident encoding in O(delta) instead of
+      re-encoding the whole map next cycle;
+    - ``notify_pass(achieved, end_map, clean)`` — called when an
+      orchestration pass adopted ``achieved`` as current; ``clean`` is
+      the controller's hint that the pass fully landed ``end_map``
+      (no supersede/cancel/failures/quarantine).  The planner owns the
+      final verification and MUST demote to a full re-encode on
+      anything it cannot prove — the conservative-protocol contract is
+      that a missed hook or failed check only ever costs a cold
+      encode, never a stale map."""
 
     async def plan_cycle(
         self,

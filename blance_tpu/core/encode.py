@@ -37,7 +37,7 @@ __all__ = ["DenseProblem", "encode_problem", "decode_assignment",
            "bucket_size", "pad_to", "pad_problem_arrays",
            "stack_problem_arrays", "pack_assignment_core",
            "pack_assignment", "prev_from_entries_core",
-           "prev_from_entries"]
+           "prev_from_entries", "pack_slot_rows", "strip_prev_rows"]
 
 # Shape-bucket granularity: buckets per power-of-two octave.  8 keeps the
 # worst-case padding overhead at 1/8 = 12.5% of the axis while collapsing
@@ -201,6 +201,48 @@ def prev_from_entries(pi, si, ri, node, p: int, s: int, r: int):  # type: ignore
         _prev_from_entries_jit = _partial(
             jax.jit, static_argnames=("p", "s", "r"))(prev_from_entries_core)
     return _prev_from_entries_jit(pi, si, ri, node, p=p, s=s, r=r)
+
+
+def pack_slot_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host pack of ``[..., S, R]`` assignment rows: non-empty slots
+    left (stable, preserving slot order) + per-(row, state) counts.
+
+    THE numpy spelling of decode_assignment's per-state pack (argsort
+    on the empty mask, ``kind="stable"``) lifted to whole rows, and the
+    host twin of the traceable :func:`pack_assignment_core` — shared by
+    the encode-residency layer (plan/resident.py) so its delta-patched
+    ``prev`` is bit-equal to what a fresh ``encode_problem`` of the
+    decoded map would scatter."""
+    mask = rows >= 0
+    order = np.argsort(~mask, axis=-1, kind="stable")
+    packed = np.take_along_axis(rows, order, axis=-1)
+    counts = mask.sum(axis=-1).astype(np.int64)
+    return packed, counts
+
+
+def strip_prev_rows(prev: np.ndarray,
+                    node_ids: np.ndarray) -> tuple[np.ndarray,
+                                                   np.ndarray]:
+    """Remove every placement on ``node_ids`` from ``prev`` [P, S, R]
+    and re-pack the touched rows left; returns ``(patched prev — a new
+    array, dirty row mask [P])``.
+
+    The array twin of ``rebalance._strip_nodes`` + re-encode: a fresh
+    ``encode_problem`` of the stripped map fills each touched row with
+    the surviving entries in their original order, packed left — which
+    is exactly mask-to-(-1) + :func:`pack_slot_rows` on those rows.
+    Untouched rows are returned byte-identical (same values, new array
+    object: callers memoize on array identity, so an in-place patch
+    could serve stale memo hits)."""
+    hit = np.isin(prev, node_ids)
+    dirty = hit.any(axis=(1, 2))
+    out = prev.copy()
+    if dirty.any():
+        sub = out[dirty]
+        sub[hit[dirty]] = -1
+        packed, _counts = pack_slot_rows(sub)
+        out[dirty] = packed
+    return out, dirty
 
 
 @dataclass

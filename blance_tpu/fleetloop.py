@@ -58,17 +58,20 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
 
 from .control import CyclePlanner
-from .core.encode import decode_assignment, encode_problem
+from .core.encode import DenseProblem, decode_assignment, encode_problem
 from .core.types import PartitionMap, PartitionModel, PlanOptions
 from .obs import get_recorder
 from .obs.slo import FleetSloRollup, FleetSloSummary, SloTracker
 from .orchestrate.orchestrator import OrchestratorOptions
+from .plan.carry import EncodeCache
 from .plan.fleet import TenantProblem
+from .plan.resident import EncodedState, build_encoded_state
 from .plan.service import PlanService
 from .rebalance import ClusterDelta, RebalanceController
 
@@ -78,17 +81,46 @@ __all__ = ["FleetController", "ServicePlanner", "TenantLoop"]
 class ServicePlanner(CyclePlanner):
     """One tenant's :class:`~blance_tpu.control.CyclePlanner` over the
     shared :class:`~blance_tpu.plan.service.PlanService` (module doc:
-    encode → submit → decode, with the conservative warm protocol)."""
+    encode → submit → decode, with the conservative warm protocol).
 
-    def __init__(self, key: str, service: PlanService) -> None:
+    With ``encode_residency`` (the default) the encode/decode halves
+    are DELTA-RESIDENT (:mod:`blance_tpu.plan.resident`): the interned
+    problem arrays live in an :class:`~blance_tpu.plan.carry.
+    EncodeCache` keyed by tenant, each cycle patches them in O(delta)
+    (dark-set flips, weight-row writes, strip scatters), adoption
+    replaces ``prev`` with the landed solve's packed assignment, and
+    decode patches the held map at the changed rows — a warm converge
+    cycle writes only dirty rows + scalars instead of re-running
+    ``encode_problem``/``decode_assignment`` over the whole cluster.
+    The warm-SOLVE protocol (the ``dirty`` mask, ``_dirty_for``) is
+    byte-for-byte the pre-residency decision tree on the resident
+    arrays, so solve decisions — and therefore dispatch counts, event
+    logs and committed traces — are bit-identical either way; any
+    off-protocol event (divergent pass, supersede, statics swap, shape
+    drift, cache eviction) demotes to a full re-encode, never a stale
+    map.  ``host_phase`` accumulates host wall-clock seconds per phase
+    (encode/decode) for the bench stage's phase split."""
+
+    def __init__(self, key: str, service: PlanService, *,
+                 recorder: Optional[Any] = None,
+                 encode_cache: Optional[EncodeCache] = None,
+                 encode_residency: bool = True) -> None:
         self.key = key
         self._service = service
+        self._rec = recorder if recorder is not None else get_recorder()
+        self._resident = bool(encode_residency)
+        self._encodes = encode_cache if encode_cache is not None else (
+            EncodeCache(recorder=self._rec) if self._resident else None)
         # Fingerprint of the previous request: (dark set, partition
         # list, prev shape, N, pweights bytes, nweights bytes).  None
         # until the first cycle — the first request is always cold.
         self._last: Optional[tuple[frozenset[str], tuple[str, ...],
                                    tuple[int, ...], int, bytes,
                                    bytes]] = None
+        # Host wall-clock per planner phase (perf_counter seconds; NOT
+        # recorder/virtual time — the bench phase-split source).
+        self.host_phase: dict[str, float] = {"encode": 0.0,
+                                             "decode": 0.0}
 
     async def plan_cycle(
         self,
@@ -106,19 +138,181 @@ class ServicePlanner(CyclePlanner):
                 f"dense batch solver, which does not support "
                 f"node_score_booster/node_scorer/node_sorter hooks — "
                 f"run this tenant on a local planner instead")
-        problem = encode_problem(current, current, nodes, removes,
-                                 model, opts)
+        t0 = time.perf_counter()
+        problem, st = self._encode(current, nodes, removes, model, opts)
         fp = (frozenset(removes), tuple(problem.partitions),
               tuple(problem.prev.shape), problem.N,
               problem.partition_weights.tobytes(),
               problem.node_weights.tobytes())
         dirty = self._dirty_for(problem, fp)
         tenant = TenantProblem.from_dense(self.key, problem, dirty=dirty)
+        self.host_phase["encode"] += time.perf_counter() - t0
         result = await self._service.submit(tenant)
-        next_map, warnings = decode_assignment(
-            problem, result.assign, current, removes)
+        t1 = time.perf_counter()
+        if st is None:
+            next_map, warnings = decode_assignment(
+                problem, result.assign, current, removes)
+            if self._resident:
+                self._rec.count("fleet.decode_full")
+        else:
+            next_map, warnings, full, nrows = st.decode(
+                np.asarray(result.assign), current, removes)
+            self._rec.count("fleet.decode_full" if full
+                            else "fleet.decode_patch")
+            if not full:
+                self._rec.observe("fleet.decode_dirty_rows",
+                                  float(nrows))
         self._last = fp
+        self.host_phase["decode"] += time.perf_counter() - t1
         return next_map, warnings
+
+    # -- the encode-residency layer (plan/resident.py) ---------------------
+
+    def _encode(self, current: PartitionMap, nodes: list[str],
+                removes: list[str], model: PartitionModel,
+                opts: PlanOptions) -> tuple[DenseProblem,
+                                            Optional[EncodedState]]:
+        """The cycle's encoded problem: the resident arrays patched in
+        O(delta) when the warm-encode protocol holds, else a full
+        ``encode_problem`` (counted ``fleet.encode_cold``; every such
+        cold beyond a tenant's first is preceded by exactly one counted
+        demotion or eviction)."""
+        if not self._resident:
+            return encode_problem(current, current, nodes, removes,
+                                  model, opts), None
+        assert self._encodes is not None
+        rec = self._rec
+        st = self._encodes.get(self.key)
+        if st is not None:
+            reason = self._warm_gate(st, current, nodes, model, opts)
+            if reason is None:
+                rows = 0
+                nbytes = 0
+                added = st.apply_nodes(nodes, opts)
+                if added is None:
+                    self._encodes.invalidate(self.key, "nodes")
+                    st = None
+                else:
+                    nbytes += added[1]
+                    rows += st.apply_removes(frozenset(removes))
+                    wrows, wbytes = st.apply_weights(opts)
+                    rows += wrows
+                    nbytes += wbytes
+                    rec.count("fleet.encode_warm")
+                    if rows:
+                        rec.observe("fleet.encode_patch_rows",
+                                    float(rows))
+                    if nbytes:
+                        rec.count("fleet.encode_patch_bytes", nbytes)
+                    return st.problem, st
+            else:
+                self._encodes.invalidate(self.key, reason)
+                st = None
+        problem = encode_problem(current, current, nodes, removes,
+                                 model, opts)
+        st = build_encoded_state(problem, current, removes, model, opts)
+        if st is not None:
+            # Counted only when resident state is actually
+            # (re)established: an out-of-protocol tenant (pass-through
+            # states, degenerate shapes) full-encodes every cycle by
+            # design, and counting those would break the attribution
+            # bound (tenants <= encode_cold <= tenants + demotions +
+            # evictions) the perf-smoke gate pins.  Its full decodes
+            # still show as fleet.decode_full.
+            rec.count("fleet.encode_cold")
+            self._encodes.put(self.key, st)
+        return problem, st
+
+    def _warm_gate(self, st: EncodedState, current: PartitionMap,
+                   nodes: list[str], model: PartitionModel,
+                   opts: PlanOptions) -> Optional[str]:
+        """The conservative protocol: None when the resident state may
+        be delta-patched for this cycle, else the demotion reason.  The
+        one warm entry besides an adopted pass: ``current`` IS the map
+        object this planner returned last cycle (a direct caller
+        adopting the proposal wholesale) — then the pending proposal's
+        packed assignment is adopted as ``prev`` on the spot."""
+        if not st.statics_match(model, opts):
+            return "statics"
+        if current is not st.expected:
+            if st.pending is not None and current is st.pending.map:
+                rows, nbytes = st.adopt(st.pending, current)
+                self._note_patch(rows, nbytes)
+            else:
+                return "divergence"
+        else:
+            p = st.pending
+            if p is not None and not p.changed and st.map is None:
+                # A zero-move proposal: the solve changed nothing, so
+                # its decoded map IS the canonical decode of the
+                # unchanged resident prev — holding it unlocks
+                # incremental decode without waiting for a pass to
+                # land (weight-drift cycles often converge move-free).
+                st.map = p.map
+            # Any other un-adopted proposal is stale: the cluster
+            # stayed on ``expected``, so the next solve re-proposes
+            # from the same prev.
+            st.pending = None
+        if st.shape_drifted():
+            return "shape"
+        return None
+
+    def _note_patch(self, rows: int, nbytes: int) -> None:
+        if rows:
+            self._rec.observe("fleet.encode_patch_rows", float(rows))
+        if nbytes:
+            self._rec.count("fleet.encode_patch_bytes", nbytes)
+
+    # -- controller notifications (rebalance.RebalanceController) ----------
+
+    def notify_strip(self, nodes: set[str], before: PartitionMap,
+                     after: PartitionMap) -> None:
+        """An abrupt-fail strip replaced the controller's current map:
+        patch the resident prev/map at the holder rows and re-key the
+        identity token, or demote when the strip did not start from the
+        map we encode."""
+        if not self._resident:
+            return
+        assert self._encodes is not None
+        st = self._encodes.get(self.key)
+        if st is None:
+            return
+        if st.expected is not before:
+            self._encodes.invalidate(self.key, "divergence")
+            return
+        rows, nbytes = st.apply_strip(nodes, after)
+        self._note_patch(rows, nbytes)
+
+    def notify_pass(self, achieved: PartitionMap,
+                    end_map: PartitionMap, clean: bool) -> None:
+        """An orchestration pass adopted ``achieved`` as current.  When
+        the pass landed OUR pending proposal verbatim (``clean`` hint
+        from the controller, the target is identical to the proposal
+        object, and every row the proposal changed reads back equal),
+        adopt: the packed assignment becomes ``prev`` and ``achieved``
+        the identity token.  Anything else — supersede, failures,
+        quarantine strips, a locally-planned degraded pass — demotes to
+        a cold re-encode.  Never a stale map: rows the proposal did not
+        change are the held map's own objects, so only changed rows
+        need the read-back check."""
+        if not self._resident:
+            return
+        assert self._encodes is not None
+        st = self._encodes.get(self.key)
+        if st is None:
+            return
+        p = st.pending
+        if not clean or p is None or end_map is not p.map:
+            self._encodes.invalidate(self.key, "divergence")
+            return
+        for pname in p.changed:
+            got = achieved.get(pname)
+            if got is None or \
+                    got.nodes_by_state != p.map[pname].nodes_by_state:
+                self._encodes.invalidate(self.key, "divergence")
+                return
+        rows, nbytes = st.adopt(p, achieved)
+        self._note_patch(rows, nbytes)
 
     def _dirty_for(self, problem: Any,
                    fp: tuple) -> Optional[np.ndarray]:
@@ -191,6 +385,9 @@ class FleetController:
         max_passes_per_cycle: int = 8,
         availability_floor: Optional[float] = None,
         recorder: Optional[Any] = None,
+        encode_residency: bool = True,
+        encode_bytes: Optional[int] = 256 << 20,
+        encode_entries: Optional[int] = 16384,
     ) -> None:
         self.nodes_all = list(nodes_all)
         self._rec = recorder if recorder is not None else get_recorder()
@@ -221,6 +418,14 @@ class FleetController:
         self.max_passes_per_cycle = max_passes_per_cycle
         self.availability_floor = availability_floor
         self._tenants: dict[str, TenantLoop] = {}
+        # Encode residency (docs/DESIGN.md): one shared keyed store of
+        # per-tenant resident encode state, the encode-layer sibling of
+        # the service's CarryCache — bounded, with eviction only ever
+        # costing a cold re-encode.
+        self.encode_residency = bool(encode_residency)
+        self.encode_cache: Optional[EncodeCache] = EncodeCache(
+            max_bytes=encode_bytes, max_entries=encode_entries,
+            recorder=self._rec) if self.encode_residency else None
         self.rollup = FleetSloRollup(
             availability_floor, recorder=self._rec,
             clock=self._rec.now)
@@ -308,7 +513,10 @@ class FleetController:
             track_timeline=True,
             availability_floor=self.availability_floor,
             publish_gauges=False)
-        planner = ServicePlanner(key, self.service)
+        planner = ServicePlanner(
+            key, self.service, recorder=self._rec,
+            encode_cache=self.encode_cache,
+            encode_residency=self.encode_residency)
         controller = RebalanceController(
             model, list(self.nodes_all), initial_map, assign_partitions,
             plan_options=(plan_options if plan_options is not None
@@ -331,8 +539,11 @@ class FleetController:
 
     def forget_tenant(self, key: str) -> None:
         """Drop a tenant's registration (the caller stops its
-        controller); its carry-cache entry ages out via the LRU."""
+        controller); its carry-cache entry ages out via the LRU and
+        its resident encode state is dropped outright."""
         self._tenants.pop(key, None)
+        if self.encode_cache is not None:
+            self.encode_cache.drop(key)
         self.rollup.forget(key)
         self.publish_rollup()
 
@@ -382,6 +593,20 @@ class FleetController:
     def summary(self) -> FleetSloSummary:
         """The fleet scorecard (per-tenant summaries included)."""
         return self.rollup.summary()
+
+    def host_phases(self) -> dict[str, float]:
+        """Cumulative HOST wall-clock seconds per converge-cycle phase
+        across every tenant loop: ``encode``/``decode`` from the
+        planners, ``device`` from the service's solve worker.  This is
+        perf_counter time (not the virtual clock), so it is NOT part of
+        the replayable account — it is the bench phase-split source
+        that makes the host-encode share visible (docs/FLEET.md)."""
+        out = {"encode": 0.0, "decode": 0.0,
+               "device": float(self.service.host_solve_s)}
+        for loop in self._tenants.values():
+            out["encode"] += loop.planner.host_phase["encode"]
+            out["decode"] += loop.planner.host_phase["decode"]
+        return out
 
     @property
     def cycles(self) -> int:

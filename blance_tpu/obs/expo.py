@@ -356,6 +356,49 @@ def default_registry() -> MetricsRegistry:
                "byte-budget LRU, entries = key-count LRU drop, shape = "
                "re-shaped problem reset) — every one costs the key one "
                "cold solve"),
+        # -- encode residency (plan/resident.py + fleetloop.py
+        # ServicePlanner; docs/DESIGN.md "Encode residency") ------------------
+        Metric("fleet.encode_cold", "counter",
+               "full encode_problem runs that (re)established resident "
+               "state: a tenant's first cycle, or one after a counted "
+               "demotion/eviction (tenants <= cold <= tenants + "
+               "demotions + evictions; out-of-protocol tenants' "
+               "every-cycle full encodes show as fleet.decode_full "
+               "instead)"),
+        Metric("fleet.encode_warm", "counter",
+               "converge cycles served by delta-patching the resident "
+               "encode state (O(delta) host work, no re-encode)"),
+        Metric("fleet.encode_demotions", "counter",
+               "resident encode states dropped by the conservative "
+               "protocol, labeled by reason (divergence = pass/strip "
+               "did not land the held map, statics = model/options "
+               "swap, nodes = node-list drift, shape = slot-depth "
+               "drift) — each costs the key one cold re-encode"),
+        Metric("fleet.encode_evictions", "counter",
+               "resident encode states dropped by the EncodeCache "
+               "budgets, labeled by reason (bytes / entries) — each "
+               "costs the key one cold re-encode"),
+        Metric("fleet.encode_patch_rows", "histogram",
+               "prev/weight rows written per resident delta patch "
+               "(strip scatters, weight-drift rows, adopted-pass "
+               "scatters, dark-set flips)"),
+        Metric("fleet.encode_patch_bytes", "counter",
+               "array bytes written by resident encode delta patches — "
+               "the warm cycle's whole fresh-data footprint (bounded "
+               "by dirty rows + scalars; the perf-smoke gate pins it)"),
+        Metric("fleet.decode_full", "counter",
+               "full decode_assignment runs on the planner path (cold "
+               "cycles, first decode after a cold encode, pass-through "
+               "tenants)"),
+        Metric("fleet.decode_patch", "counter",
+               "incremental decodes: held map patched at the changed "
+               "rows, bit-identical to the full decode"),
+        Metric("fleet.decode_dirty_rows", "histogram",
+               "rows rebuilt per incremental decode (the rows the "
+               "solve actually changed)"),
+        Metric("fleet.h2d_bytes", "counter",
+               "host->device bytes shipped as stacked fleet batch "
+               "tensors, summed per dispatch"),
         Metric("fleet.tenants", "gauge",
                "tenant control loops registered with the fleet rollup"),
         Metric("fleet.converge_cycles", "gauge",

@@ -463,6 +463,11 @@ def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
         rec.observe("fleet.batch_tenants", float(b_real))
         rec.observe("fleet.batch_occupancy",
                     b_real / b_padded if b_padded else 0.0)
+        # Host->device transfer accounting: the stacked batch tensors
+        # this dispatch ships (deterministic — a pure function of the
+        # batch's shapes, so exposition text stays replay-identical).
+        rec.count("fleet.h2d_bytes",
+                  sum(int(np.asarray(a).nbytes) for a in fn_args))
     return tuple(np.asarray(o)[:b_real] for o in outs)
 
 

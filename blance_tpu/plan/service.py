@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 import warnings
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -168,6 +169,12 @@ class PlanService:
             else CarryCache(max_bytes=carry_bytes,
                             max_entries=carry_entries,
                             recorder=self._rec)
+        # Cumulative HOST wall-clock seconds spent inside the fleet
+        # solve (single writer: the solve runs on the dispatcher
+        # coroutine or the one-thread executor).  perf_counter time,
+        # not the recorder clock — the bench phase-split's "device"
+        # share (fleet.dispatch_s is virtual under DeterministicLoop).
+        self.host_solve_s = 0.0
         self._queue: "asyncio.Queue[object]" = \
             asyncio.Queue(maxsize=max_pending)
         # Over-quota requests rolled out of a coalescing window by the
@@ -405,10 +412,12 @@ class PlanService:
         (batch closed → solver started) from its ``device`` segment."""
         rec = self._rec
         t_start = rec.now()
+        w0 = time.perf_counter()
         results = solve_fleet(
             problems, mesh=self.mesh,
             max_iterations=self.max_iterations, recorder=rec,
             trace_ids=trace_ids, batch_floor=self.batch_floor)
+        self.host_solve_s += time.perf_counter() - w0
         return t_start, rec.now(), results
 
     async def _run(self) -> None:

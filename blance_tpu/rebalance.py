@@ -738,12 +738,20 @@ class RebalanceController(CycleEngine):
                      if n in self._nodes and n not in self._failed]
             if fresh:
                 self._failed.update(fresh)
+                before = self.current
                 self.current = _strip_nodes(self.current, set(fresh))
                 t = self._rec.now()
                 if self._slo is not None:
                     self._slo.strip_nodes(set(fresh), t)
                 for hook in self.on_strip:
                     hook(set(fresh), t)
+                # Encode residency (docs/DESIGN.md): an async planner
+                # holding resident encode state patches its prev at
+                # the holder rows instead of re-encoding the stripped
+                # map next cycle.
+                notify = getattr(self._planner, "notify_strip", None)
+                if notify is not None:
+                    notify(set(fresh), before, self.current)
             if delta.partition_weights:
                 self._pweights.update(delta.partition_weights)
                 weights_changed = True
@@ -980,7 +988,7 @@ class RebalanceController(CycleEngine):
             await o.wait_drained()
             break
         await drain
-        self._adopt(o)
+        self._adopt(o, superseded=superseded)
         return superseded, o.move_failures()
 
     async def _drain_progress(self, o: Orchestrator) -> None:
@@ -988,7 +996,7 @@ class RebalanceController(CycleEngine):
             pass
         o.stop()
 
-    def _adopt(self, o: Orchestrator) -> None:
+    def _adopt(self, o: Orchestrator, superseded: bool = False) -> None:
         """Fold one finished pass into the controller view (sync: one
         atomic window).  Quarantined placements are presumed lost, like
         rebalance_async's recovery presumption."""
@@ -1006,6 +1014,19 @@ class RebalanceController(CycleEngine):
         self.failures.extend(failures)
         self.current = achieved
         self._inflight = None
+        notify = getattr(self._planner, "notify_pass", None)
+        if notify is not None:
+            # Encode residency: a clean-hinted pass (fully drained, no
+            # cancel/supersede/failures/quarantine/errors) lets the
+            # planner adopt its proposal's packed assignment as the
+            # next resident prev; the planner itself still verifies the
+            # changed rows landed verbatim, and anything off-hint
+            # demotes to a cold re-encode.
+            clean = (not superseded and not self._stopping
+                     and not failures and not quarantined
+                     and o._progress.tot_cancel == 0
+                     and not o._progress.errors)
+            notify(achieved, o.end_map, clean)
         if self.session is not None and not failures and \
                 not quarantined and \
                 _maps_equal(self.current, o.end_map):

@@ -131,8 +131,21 @@ class FleetSimReport:
     admission_p50_s: float
     admission_p99_s: float
     exposition: str
+    # Encode-residency economics (ISSUE 14): all deterministic
+    # counters, safe to compare across runs.
+    encode_cold: int = 0
+    encode_warm: int = 0
+    encode_demotions: dict[str, int] = field(default_factory=dict)
+    encode_evictions: dict[str, int] = field(default_factory=dict)
+    encode_patch_bytes: int = 0
+    encode_patch_rows: int = 0
+    decode_full: int = 0
+    decode_patch: int = 0
     steps: int = 0
     wall_s: float = 0.0  # host time; NOT part of the replayable account
+    # Host wall-clock split of the cycle cost (encode / decode /
+    # device / other); like wall_s, NOT part of the replayable account.
+    phase_wall: dict[str, float] = field(default_factory=dict)
 
     def log_text(self) -> str:
         return canonical_fleet_log_text(self.events)
@@ -184,7 +197,8 @@ def _map_complete(pmap: PartitionMap, mdl: PartitionModel,
 
 
 async def _fleet_main(scn: FleetScenario, loop: DeterministicLoop,
-                      rec: Recorder, coalesce: bool) -> FleetSimReport:
+                      rec: Recorder, coalesce: bool,
+                      encode_residency: bool = True) -> FleetSimReport:
     log = _FleetLog()
     specs = {t.key: t for t in scn.tenants}
     models = {t.key: tenant_model(t) for t in scn.tenants}
@@ -205,7 +219,8 @@ async def _fleet_main(scn: FleetScenario, loop: DeterministicLoop,
         debounce_s=scn.debounce_s,
         max_passes_per_cycle=scn.max_passes_per_cycle,
         availability_floor=scn.availability_floor,
-        recorder=rec)
+        recorder=rec,
+        encode_residency=encode_residency)
     await fc.start()
 
     def onboard(spec: FleetTenant, t0: bool) -> None:
@@ -315,6 +330,8 @@ async def _fleet_main(scn: FleetScenario, loop: DeterministicLoop,
         cycles=fc.cycles, passes=fc.passes,
         superseded=fc.superseded, unconverged=fc.unconverged_cycles)
 
+    phase_wall = fc.host_phases()
+    enc_cache = fc.encode_cache
     await fc.stop()
 
     lat = sorted(rec.histograms.get("fleet.admission_latency_s", []))
@@ -331,21 +348,39 @@ async def _fleet_main(scn: FleetScenario, loop: DeterministicLoop,
         unconverged=fc.unconverged_cycles,
         admission_p50_s=(percentile(lat, 50) if lat else 0.0),
         admission_p99_s=(percentile(lat, 99) if lat else 0.0),
-        exposition=render_prometheus(rec))
+        exposition=render_prometheus(rec),
+        encode_cold=int(rec.counters.get("fleet.encode_cold", 0)),
+        encode_warm=int(rec.counters.get("fleet.encode_warm", 0)),
+        encode_demotions=(dict(enc_cache.demotions)
+                          if enc_cache is not None else {}),
+        encode_evictions=(dict(enc_cache.evictions)
+                          if enc_cache is not None else {}),
+        encode_patch_bytes=int(
+            rec.counters.get("fleet.encode_patch_bytes", 0)),
+        encode_patch_rows=int(rec._hist_stats.get(
+            "fleet.encode_patch_rows", (0, 0.0))[1]),  # exact sum
+        decode_full=int(rec.counters.get("fleet.decode_full", 0)),
+        decode_patch=int(rec.counters.get("fleet.decode_patch", 0)),
+        phase_wall=phase_wall)
 
 
 def run_fleet_scenario(scn: FleetScenario,
-                       coalesce: bool = True) -> FleetSimReport:
+                       coalesce: bool = True,
+                       encode_residency: bool = True) -> FleetSimReport:
     """Run one fleet scenario to completion under the virtual clock.
     Pure function of (scenario, coalesce): same inputs -> byte-identical
-    event log, SLO summaries and exposition text; ``wall_s``/``steps``
-    are the only host-dependent fields."""
+    event log, SLO summaries and exposition text; ``wall_s``/``steps``/
+    ``phase_wall`` are the only host-dependent fields.
+    ``encode_residency=False`` runs the full-re-encode-per-cycle
+    baseline — a pure perf toggle: the event log and every replayable
+    quantity are byte-identical either way (tests pin this), only the
+    host wall-clock and the ``fleet.encode_*`` accounting differ."""
     loop = DeterministicLoop(FifoPolicy(), max_steps=scn.max_steps)
     rec = Recorder(clock=loop.time)
     t0 = time.perf_counter()
     with use_recorder(rec):
         report = loop.run_until_complete(
-            _fleet_main(scn, loop, rec, coalesce))
+            _fleet_main(scn, loop, rec, coalesce, encode_residency))
     report.wall_s = time.perf_counter() - t0
     report.steps = loop.steps
     return report

@@ -454,14 +454,20 @@ def _fleet_tenants(rng: random.Random, n: int,
         for i in range(n))
 
 
-def fleet_zone_outage(seed: int = 5, tenants: int = 8) -> FleetScenario:
+def fleet_zone_outage(seed: int = 5, tenants: int = 8,
+                      partitions: "tuple[int, ...]" = (12, 16),
+                      ) -> FleetScenario:
     """Correlated zone outage: one zone's nodes fail for EVERY tenant
     at once — N coalesced converge cycles through a handful of fleet
-    dispatches — then return; two tenants heat up afterwards."""
+    dispatches — then return; two tenants heat up afterwards.
+
+    ``partitions`` overrides the tenant-size choice set (bench's
+    encode-residency A/B uses bigger tenants so the host-encode share
+    is visible); the default reproduces the committed traces."""
     rng = random.Random(f"fzone:{seed}:{tenants}")
     nodes = _zone_nodes(3, 4)
     z1 = tuple(n for n in nodes if n.startswith("z1"))
-    ts = _fleet_tenants(rng, tenants, (12, 16), lambda i: 0.0)
+    ts = _fleet_tenants(rng, tenants, partitions, lambda i: 0.0)
     hot = sorted(rng.sample([t.key for t in ts], min(2, tenants)))
     t_down = _jitter(rng, 600, 30)
     events = [

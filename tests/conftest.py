@@ -151,6 +151,12 @@ _RECOMPILE_BUDGETS = {
     "test_sharded": 260,
     "test_sharded_2d": 260,
     "test_fleet": 50,
+    #   test_encode_resident total=20 standalone (fleet_cold 11,
+    #                     fleet_warm 9 — the residency layer adds ZERO
+    #                     new device programs by design: every cycle
+    #                     rides the existing bucketed fleet batch
+    #                     entries, so the budget pins exactly that)
+    "test_encode_resident": 28,
     #   test_pipeline     total=360 standalone (impl 8+7, solve 7, diff 7,
     #                     '<unnamed' bulk = eager ops + the memoized
     #                     sharded-pipeline programs across 5 meshes)

@@ -621,6 +621,7 @@ def test_race_lint_real_package_model_matches_reality():
         "CostModel": inspect.getsource(costmodel.CostModel),
         "PlanService": inspect.getsource(planservice.PlanService),
         "CarryCache": inspect.getsource(plancarry.CarryCache),
+        "EncodeCache": inspect.getsource(plancarry.EncodeCache),
         "RebalanceController": inspect.getsource(
             rebalance.RebalanceController),
         "_CriticalPathBound": inspect.getsource(
@@ -816,7 +817,7 @@ def test_shape_audit_passes_against_live_solver():
     findings, entries = run_shape_audit()
     rendered = "\n".join(f.render() for f in findings)
     assert findings == [], f"shape contract violations:\n{rendered}"
-    assert entries == len(CONTRACTS) + 3
+    assert entries == len(CONTRACTS) + 4  # + encode-residency check
     # Acceptance coverage: warm, sharded and bucketed variants all audit.
     entry_names = {c.entry for c in CONTRACTS}
     assert {"solve_dense", "solve_dense_converged", "solve_dense_warm",
