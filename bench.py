@@ -1181,6 +1181,106 @@ def bench_fleet_loop(tenants=8, seed=5):
     return out
 
 
+def bench_durability(seed=19):
+    """Durability stage (ISSUE 18): crash-recovery cost and correctness
+    on the ``crash_storm`` chain (docs/DURABILITY.md).
+
+    Runs the crash-free reference, then the scripted three-crash chain
+    — every restart recovers from the WAL into a fresh virtual loop and
+    must converge to the reference's final map bit-identically, with
+    recovery cold solves bounded by the counted attribution identity
+    (one per resumed tenant per recovery).  Then measures the recovery
+    path itself: wall-clock ``recover()`` over the completed journal
+    (records replayed per ms is the headline recovery rate) and the
+    epoch fence — a zombie pre-crash journal handle must have its
+    append REJECTED and counted, never applied.
+
+    ``gates`` collects every pass/fail the perf-smoke tier checks."""
+    import shutil
+    import tempfile
+
+    from blance_tpu.durability import Journal, recover, reset_fences
+    from blance_tpu.obs import Recorder, use_recorder
+    from blance_tpu.testing.crashsim import (
+        maps_identical, run_crash_scenario)
+    from blance_tpu.testing.scenarios import crash_storm
+
+    os.environ.setdefault("BLANCE_WAL_FSYNC", "0")
+    cs = crash_storm(seed)
+    base = tempfile.mkdtemp(prefix="blance-durability-")
+    try:
+        reset_fences()
+        ref = run_crash_scenario(cs.base, os.path.join(base, "ref"))
+        storm = run_crash_scenario(
+            cs.base, os.path.join(base, "storm"), crashes=cs.crashes,
+            snapshot_every=cs.snapshot_every,
+            rotate_records=cs.rotate_records)
+        identical = maps_identical(storm.final_map, ref.final_map)
+        recoveries = int(storm.counters.get("durability.recoveries", 0))
+        cold = int(storm.counters.get(
+            "durability.recovery_cold_solves", 0))
+        # One tenant per life in this scenario: the attribution bound
+        # is exactly one counted cold solve per recovery.
+        cold_bounded = cold <= recoveries
+
+        # Recovery-time measurement over the storm run's full journal
+        # (its final epoch's history), plus the fence check: a journal
+        # handle opened BEFORE the recovery is a zombie afterwards.
+        rec = Recorder()
+        with use_recorder(rec):
+            reset_fences()
+            storm_dir = os.path.join(base, "storm")
+            zombie = Journal(storm_dir)
+            t0 = time.perf_counter()
+            state = recover(storm_dir)
+            recover_ms = (time.perf_counter() - t0) * 1e3
+            state.journal.close()
+            zombie_applied = zombie.append("delta", {"zombie": True})
+            zombie.close()
+        stale_counted = rec.counters.get(
+            "durability.stale_epoch_rejections", 0) >= 1
+        gates = {
+            "final_map_identical": bool(identical),
+            "chain_completed": storm.lives == len(cs.crashes) + 1,
+            "cold_solves_bounded": bool(cold_bounded),
+            "zombie_append_rejected": (not zombie_applied)
+            and stale_counted,
+        }
+        out = {
+            "scenario": cs.name,
+            "seed": seed,
+            "crashes": list(cs.crashes),
+            "lives": storm.lives,
+            "recoveries": recoveries,
+            "recovery_cold_solves": cold,
+            "records_replayed": state.records_replayed,
+            "recover_ms": round(recover_ms, 3),
+            "records_per_ms": round(
+                state.records_replayed / recover_ms, 2)
+            if recover_ms > 0 else None,
+            "torn_segments": state.torn_segments,
+            "stale_dropped": state.stale_dropped,
+            "journal_records": int(storm.counters.get(
+                "durability.journal_records", 0)),
+            "journal_bytes": int(storm.counters.get(
+                "durability.journal_bytes", 0)),
+            "snapshots": int(storm.counters.get(
+                "durability.snapshots", 0)),
+            "gates": gates,
+            "pass": all(gates.values()),
+        }
+    finally:
+        reset_fences()
+        shutil.rmtree(base, ignore_errors=True)
+    log(f"[durability {cs.name} s{seed}] lives={out['lives']} "
+        f"recoveries={out['recoveries']} cold={cold} "
+        f"recover {out['recover_ms']}ms for "
+        f"{out['records_replayed']} records "
+        f"({out['records_per_ms']}/ms), identical={identical} "
+        f"zombie_rejected={gates['zombie_append_rejected']}")
+    return out
+
+
 def _timed(fn):
     t0 = time.perf_counter()
     fn()
@@ -2152,13 +2252,27 @@ def _run_perf_smoke():
         floop_ok = False
     ok = ok and floop_ok
 
+    # Durability gate (ISSUE 18): the crash_storm recovery chain must
+    # converge to the crash-free reference's final map bit-identically,
+    # with recovery cold solves inside the counted attribution bound
+    # and a zombie (pre-recovery) journal handle's append rejected and
+    # counted — plus the recovery-time numbers the round reports.
+    try:
+        durability = bench_durability()
+        durability_ok = durability["pass"]
+    except Exception as e:  # any stage crash must fail THIS gate
+        durability = {"error": first_line(e)}
+        durability_ok = False
+    ok = ok and durability_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
         "unit": "sweeps",
         "vs_baseline": res["cold_sweeps"],
         "detail": {**res, "pipeline": pipe, "sparse": sparse,
-                   "sched": sched, "fleet_loop": floop},
+                   "sched": sched, "fleet_loop": floop,
+                   "durability": durability},
         "pass": ok,
     }))
     if not ok:
@@ -2167,7 +2281,8 @@ def _run_perf_smoke():
             f"identical={res['identical']}); pipeline "
             f"{'OK' if pipe_ok else f'FAILED: {pipe}'}; sparse "
             f"{'OK' if sparse_ok else f'FAILED: {sparse}'}; fleet_loop "
-            f"{'OK' if floop_ok else f'FAILED: {floop}'}")
+            f"{'OK' if floop_ok else f'FAILED: {floop}'}; durability "
+            f"{'OK' if durability_ok else f'FAILED: {durability}'}")
         sys.exit(1)
 
 

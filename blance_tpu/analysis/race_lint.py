@@ -162,6 +162,21 @@ SHARED_STATE: dict[str, frozenset[str]] = {
         "_rank", "plan", "last_remaining", "_quarantined",
         "_t_last_exec", "_first_predicted", "_finished", "reschedules",
     }),
+    # -- durability journal (blance_tpu/durability) --------------------------
+    # The Journal is written from the controller's cycle task (genesis/
+    # delta/cycle/plan/strip/quiesce/snapshot) AND from every mover
+    # task (the batch observer hook) — under the fleet tier, from N
+    # tenant loops at once through their TenantViews.  Discipline:
+    # append() is the single funnel and is plain sync code with no
+    # awaits, so each record's seq/segment/snapshot-cadence update is
+    # one atomic window on the event loop.  The EpochFence's counter is
+    # read on every append and bumped only by recover() (sync, before
+    # any successor task starts).
+    "Journal": frozenset({
+        "_seq", "_records_in_seg", "records_since_snapshot", "_f",
+        "segment",
+    }),
+    "EpochFence": frozenset({"_epoch"}),
 }
 
 # Container mutators: a call to one of these on a shared attribute is a

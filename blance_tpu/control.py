@@ -174,6 +174,7 @@ class CycleEngine:
                 if deltas:
                     self._apply_deltas(deltas)
                     self.cycles += 1
+                    self._on_cycle(self.cycles, len(deltas))
                     await self._converge()
         finally:
             self._on_exit()
@@ -209,7 +210,12 @@ class CycleEngine:
 
     def _on_submit(self, delta: Any) -> None:
         """Sync hook inside :meth:`submit`'s atomic window (counters,
-        SLO incident opening)."""
+        SLO incident opening, WAL delta-intake records)."""
+
+    def _on_cycle(self, n: int, deltas: int) -> None:
+        """Sync hook at cycle begin — after the delta burst folded into
+        the view, before convergence starts.  The explicit cycle-begin
+        seam the durability journal records through."""
 
     def _on_stop_soon(self) -> None:
         """Sync hook inside :meth:`stop_soon` (cancel in-flight work)."""

@@ -59,7 +59,11 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import time
-from typing import Any, Callable, Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - type-only (import cycle)
+    from .durability.journal import Journal
+    from .durability.recover import RecoveredState
 
 import numpy as np
 
@@ -388,6 +392,7 @@ class FleetController:
         encode_residency: bool = True,
         encode_bytes: Optional[int] = 256 << 20,
         encode_entries: Optional[int] = 16384,
+        journal: "Optional[Journal]" = None,
     ) -> None:
         self.nodes_all = list(nodes_all)
         self._rec = recorder if recorder is not None else get_recorder()
@@ -429,6 +434,11 @@ class FleetController:
         self.rollup = FleetSloRollup(
             availability_floor, recorder=self._rec,
             clock=self._rec.now)
+        # One shared WAL for the whole fleet (docs/DURABILITY.md):
+        # every tenant journals through a tenant-tagged view of it,
+        # and fleet-tier membership events land untagged — recovery
+        # groups records back per tenant.
+        self._journal = journal
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -517,6 +527,10 @@ class FleetController:
             key, self.service, recorder=self._rec,
             encode_cache=self.encode_cache,
             encode_residency=self.encode_residency)
+        if self._journal is not None:
+            self._journal.append(
+                "fleet", {"event": "add_tenant", "tenant": key},
+                t=self._rec.now())
         controller = RebalanceController(
             model, list(self.nodes_all), initial_map, assign_partitions,
             plan_options=(plan_options if plan_options is not None
@@ -528,7 +542,9 @@ class FleetController:
             planner=planner,
             debounce_s=self.debounce_s,
             max_passes_per_cycle=self.max_passes_per_cycle,
-            slo=slo, move_observers=move_observers)
+            slo=slo, move_observers=move_observers,
+            journal=(self._journal.for_tenant(key)
+                     if self._journal is not None else None))
         self._tenants[key] = TenantLoop(key, controller, planner, slo)
         self.rollup.register(key, slo)
         controller.start()
@@ -537,10 +553,62 @@ class FleetController:
         self.publish_rollup()
         return controller
 
+    def resume_tenant(
+        self,
+        state: "RecoveredState",
+        key: str,
+        model: PartitionModel,
+        assign_partitions: Callable[..., object],
+        *,
+        plan_options: Optional[PlanOptions] = None,
+        orchestrator_options: Optional[OrchestratorOptions] = None,
+        move_observers: tuple = (),
+        kick: bool = True,
+    ) -> RebalanceController:
+        """Re-onboard one tenant from a crashed fleet's recovered
+        journal state (docs/DURABILITY.md): same service/planner wiring
+        as :meth:`add_tenant`, but the map, membership residue, breaker
+        state and SLO horizon come from the journal fold.  The tenant's
+        carry/encode residency was never persisted, so its first plan
+        is a counted cold solve (``durability.recovery_cold_solves``)
+        — inside the fleet tier's demotion attribution bound."""
+        from .durability.recover import resume_controller
+
+        if key in self._tenants:
+            raise ValueError(f"tenant {key!r} already registered")
+        planner = ServicePlanner(
+            key, self.service, recorder=self._rec,
+            encode_cache=self.encode_cache,
+            encode_residency=self.encode_residency)
+        controller = resume_controller(
+            state, model, assign_partitions, tenant=key,
+            plan_options=(plan_options if plan_options is not None
+                          else self.plan_options),
+            orchestrator_options=(orchestrator_options
+                                  if orchestrator_options is not None
+                                  else self.orch_opts),
+            backend="greedy", planner=planner,
+            debounce_s=self.debounce_s,
+            max_passes_per_cycle=self.max_passes_per_cycle,
+            move_observers=move_observers,
+            publish_slo_gauges=False,
+            availability_floor=self.availability_floor,
+            start=True, kick=kick)
+        slo = controller._slo
+        assert slo is not None  # resume_controller always restores one
+        self._tenants[key] = TenantLoop(key, controller, planner, slo)
+        self.rollup.register(key, slo)
+        self.publish_rollup()
+        return controller
+
     def forget_tenant(self, key: str) -> None:
         """Drop a tenant's registration (the caller stops its
         controller); its carry-cache entry ages out via the LRU and
         its resident encode state is dropped outright."""
+        if key in self._tenants and self._journal is not None:
+            self._journal.append(
+                "fleet", {"event": "forget_tenant", "tenant": key},
+                t=self._rec.now())
         self._tenants.pop(key, None)
         if self.encode_cache is not None:
             self.encode_cache.drop(key)

@@ -33,9 +33,9 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 from typing import Any, Optional, TextIO, Union
 
+from ..utils.atomicio import atomic_write_json
 from .recorder import Recorder, Span, get_recorder, percentile
 
 __all__ = ["CostModel", "EXEC_SPAN", "DEFAULT_PRIORS_PATH",
@@ -258,25 +258,15 @@ class CostModel:
         return model
 
     def save(self, path_or_file: Union[str, TextIO]) -> None:
-        """Persist as JSON; a path write is crash-atomic (same-dir temp
-        + ``os.replace``) so a scheduler never loads a torn model."""
+        """Persist as JSON; a path write goes through the shared
+        crash-atomic recipe (:mod:`blance_tpu.utils.atomicio` — same-dir
+        temp + fsync + rename + directory fsync) so a scheduler never
+        loads a torn model and a completed save survives power loss."""
         if not isinstance(path_or_file, str):
             json.dump(self.to_json(), path_or_file, indent=1, sort_keys=True)
             return
-        directory = os.path.dirname(os.path.abspath(path_or_file)) or "."
-        fd, tmp = tempfile.mkstemp(
-            prefix=os.path.basename(path_or_file) + ".", suffix=".tmp",
-            dir=directory)
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(self.to_json(), f, indent=1, sort_keys=True)
-            os.replace(tmp, path_or_file)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_json(path_or_file, self.to_json(),
+                          indent=1, sort_keys=True)
 
     @classmethod
     def load(cls, path_or_file: Union[str, TextIO],

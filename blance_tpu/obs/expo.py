@@ -414,6 +414,38 @@ def default_registry() -> MetricsRegistry:
         Metric("slo.fleet_violation_seconds", "gauge",
                "cumulative SLO-violation seconds summed across all "
                "tenant loops"),
+        # -- durability (blance_tpu/durability; docs/DURABILITY.md) ----------
+        Metric("durability.journal_records", "counter",
+               "records appended to the write-ahead journal (all kinds, "
+               "all tenants)"),
+        Metric("durability.journal_bytes", "counter",
+               "bytes appended to the write-ahead journal (framing "
+               "included)"),
+        Metric("durability.segments_rotated", "counter",
+               "journal segment rotations (a fresh crash-atomically "
+               "birthed segment file every rotate_records appends)"),
+        Metric("durability.snapshots", "counter",
+               "state snapshots written (controller map + membership + "
+               "breaker/SLO/cost state; the pointer record is the "
+               "commit point)"),
+        Metric("durability.torn_tail", "counter",
+               "journal segments whose final record was torn (partial "
+               "write / CRC or framing failure), truncated to the last "
+               "valid prefix at replay"),
+        Metric("durability.recoveries", "counter",
+               "recover() invocations: journal replays that rebuilt "
+               "controller state and fenced a new epoch"),
+        Metric("durability.replayed_records", "counter",
+               "journal records folded into recovered state across all "
+               "recoveries"),
+        Metric("durability.stale_epoch_rejections", "counter",
+               "writes or move completions rejected because their "
+               "captured epoch lost the fence (zombie pre-crash writer "
+               "or stale process) — counted, never applied"),
+        Metric("durability.recovery_cold_solves", "counter",
+               "resumed controllers whose first plan is a cold solve "
+               "(carry/encode caches are deliberately not persisted; "
+               "bounded by the fleet demotion attribution identity)"),
         # -- device (obs/device.py; all emitted only while the device
         # observatory is enabled) ---------------------------------------------
         Metric("device.compiles", "counter",

@@ -62,7 +62,11 @@ from typing import Any, Callable, Iterable, Mapping, Optional, Protocol, \
 from .recorder import Recorder, escape_label_value, get_recorder
 
 __all__ = ["FleetSloRollup", "FleetSloSummary", "MoveObserver",
-           "SloSummary", "SloTracker"]
+           "SloSummary", "SloTracker", "SLO_FORMAT_VERSION"]
+
+# On-disk schema version for SloTracker.to_dict/from_dict (durability
+# snapshots); from_dict refuses other versions.
+SLO_FORMAT_VERSION = 1
 
 # Kept as the module-local spelling; the one implementation lives in
 # obs/recorder.py so it cannot drift from obs/device.py's labels.
@@ -426,6 +430,92 @@ class SloTracker:
             rec.set_gauge(
                 f'slo.quarantine_exposure_s{{node="{_escape_label(node)}"}}',
                 exposure)
+
+    # -- serialization (durability snapshots) ---------------------------------
+
+    def to_dict(self, now: Optional[float] = None) -> dict[str, Any]:
+        """Versioned JSON-safe snapshot of the whole account — placement
+        view, churn counters, incident state, and the horizon timeline.
+
+        Every instant is stored as an AGE relative to ``now`` (the same
+        epoch-free convention as ``HealthTracker.to_dict``): the clock
+        that stamped the timeline dies with the process, so absolute
+        instants would be meaningless to a restored tracker.  Ages keep
+        every duration — integrals, dwell, lag — exact; only the
+        absolute origin shifts to the new clock's epoch.
+        """
+        t = self._clock() if now is None else now
+        return {
+            "version": SLO_FORMAT_VERSION,
+            "primary_states": sorted(self._primary_states),
+            "placements": {name: dict(d)
+                           for name, d in sorted(self._placements.items())},
+            "min_moves": self._min_moves,
+            "moves_executed": self.moves_executed,
+            "moves_failed": self.moves_failed,
+            "floor": self._floor,
+            "last_progress_age_s": t - self._t_last_progress,
+            "last_fail_age_s": (t - self._t_last_fail
+                                if self._t_last_fail is not None else None),
+            "incident_age_s": (t - self._incident_t0
+                               if self._incident_t0 is not None else None),
+            "incident_moves0": self._incident_moves0,
+            "incident_fails0": self._incident_fails0,
+            "first_converged_lags": list(self._first_converged_lags),
+            "t0_age_s": t - self._t0,
+            "timeline": ([[t - t_i, a] for t_i, a in self._timeline]
+                         if self._timeline is not None else None),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any], *,
+                  clock: Optional[Callable[[], float]] = None,
+                  recorder: Optional[Recorder] = None,
+                  now: Optional[float] = None,
+                  publish_gauges: bool = True) -> "SloTracker":
+        """Rebuild a tracker on a NEW clock from :meth:`to_dict` output.
+        Ages re-base onto the new clock (``instant = now - age``); the
+        placement-derived counts (primaries, availability) are
+        recomputed from the serialized view rather than trusted."""
+        version = data.get("version")
+        if version != SLO_FORMAT_VERSION:
+            raise ValueError(
+                f"slo snapshot version {version!r} != {SLO_FORMAT_VERSION} "
+                f"(incompatible snapshot)")
+        tracker = cls({}, primary_states=tuple(data["primary_states"]),
+                      clock=clock, recorder=recorder,
+                      availability_floor=data.get("floor"),
+                      publish_gauges=publish_gauges)
+        t = tracker._clock() if now is None else now
+        tracker._placements = {
+            str(name): {str(n): str(s) for n, s in d.items()}
+            for name, d in data["placements"].items()}
+        tracker._primaries = {
+            name: sum(1 for s in d.values() if s in tracker._primary_states)
+            for name, d in tracker._placements.items()}
+        tracker._available = sum(
+            1 for prim in tracker._primaries.values() if prim > 0)
+        tracker._total = len(tracker._placements)
+        tracker._min_moves = int(data["min_moves"])
+        tracker.moves_executed = int(data["moves_executed"])
+        tracker.moves_failed = int(data["moves_failed"])
+        tracker._t_last_progress = t - float(data["last_progress_age_s"])
+        last_fail = data.get("last_fail_age_s")
+        tracker._t_last_fail = (t - float(last_fail)
+                                if last_fail is not None else None)
+        incident = data.get("incident_age_s")
+        tracker._incident_t0 = (t - float(incident)
+                                if incident is not None else None)
+        tracker._incident_moves0 = int(data["incident_moves0"])
+        tracker._incident_fails0 = int(data["incident_fails0"])
+        tracker._first_converged_lags = [
+            float(x) for x in data["first_converged_lags"]]
+        tracker._t0 = t - float(data["t0_age_s"])
+        timeline = data.get("timeline")
+        tracker._timeline = (
+            [(t - float(age), float(a)) for age, a in timeline]
+            if timeline is not None else None)
+        return tracker
 
     def summary(self, now: Optional[float] = None) -> SloSummary:
         t = self._clock() if now is None else now

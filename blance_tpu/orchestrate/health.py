@@ -35,7 +35,11 @@ from typing import Callable, Optional
 from ..obs import get_recorder
 
 __all__ = ["HEALTHY", "QUARANTINED", "HALF_OPEN", "NodeHealth",
-           "HealthTracker"]
+           "HealthTracker", "HEALTH_FORMAT_VERSION"]
+
+# On-disk schema version for HealthTracker.to_dict/from_dict (bumped on
+# any incompatible field change; from_dict refuses other versions).
+HEALTH_FORMAT_VERSION = 1
 
 HEALTHY = "healthy"
 QUARANTINED = "quarantined"
@@ -184,3 +188,80 @@ class HealthTracker:
             if h.trips > 0:
                 out[node] = self.exposure_s(node, now)
         return out
+
+    # -- serialization (durability snapshots) --------------------------------
+
+    def to_dict(self, now: Optional[float] = None) -> dict[str, object]:
+        """Versioned JSON-safe snapshot of the whole breaker.
+
+        The open quarantine interval of a tripped node is stored as an
+        AGE (``now - tripped_at``), not an absolute instant: the clock
+        that measured ``tripped_at`` dies with the process, and a new
+        incarnation's monotonic clock has an unrelated epoch.  Ages are
+        epoch-free, so ``from_dict`` can re-base them onto whatever
+        clock the restored tracker runs on, and exposure accounting
+        stays continuous across the crash.
+        """
+        t = self.clock() if now is None else now
+        nodes: dict[str, dict[str, object]] = {}
+        for node, h in sorted(self._nodes.items()):
+            open_interval = h.state in (QUARANTINED, HALF_OPEN)
+            nodes[node] = {
+                "state": h.state,
+                "consecutive_failures": h.consecutive_failures,
+                "trips": h.trips,
+                "exposure_s": h.exposure_s,
+                "tripped_age_s": (
+                    max(t - h.tripped_at, 0.0) if open_interval else None),
+            }
+        return {
+            "version": HEALTH_FORMAT_VERSION,
+            "threshold": self.threshold,
+            "probe_after_s": self.probe_after_s,
+            "nodes": nodes,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, object], *,
+                  clock: Callable[[], float] = time.monotonic,
+                  now: Optional[float] = None) -> "HealthTracker":
+        """Rebuild a tracker on a NEW clock from :meth:`to_dict` output.
+
+        Open quarantine intervals are re-based: ``tripped_at`` becomes
+        ``now - tripped_age_s`` on the new clock, so dwell timers and
+        the open-interval exposure resume exactly where the crash cut
+        them.  ``probe_in_flight`` is deliberately NOT restored — an
+        in-flight probe died with the old process, and carrying the
+        flag would wedge admission (half-open rejects everything until
+        a completion that can never arrive); the restored node simply
+        re-admits a fresh probe when its dwell allows.
+        """
+        version = data.get("version")
+        if version != HEALTH_FORMAT_VERSION:
+            raise ValueError(
+                f"health snapshot version {version!r} != "
+                f"{HEALTH_FORMAT_VERSION} (incompatible snapshot)")
+
+        def num(v: object) -> float:
+            assert isinstance(v, (int, float)) and not isinstance(v, bool)
+            return float(v)
+
+        tracker = cls(
+            threshold=int(num(data["threshold"])),
+            probe_after_s=num(data["probe_after_s"]),
+            clock=clock)
+        t = clock() if now is None else now
+        raw_nodes = data.get("nodes", {})
+        assert isinstance(raw_nodes, dict)
+        for node, entry in raw_nodes.items():
+            assert isinstance(entry, dict)
+            age = entry.get("tripped_age_s")
+            tracker._nodes[str(node)] = NodeHealth(
+                state=str(entry["state"]),
+                consecutive_failures=int(num(entry["consecutive_failures"])),
+                trips=int(num(entry["trips"])),
+                tripped_at=(t - num(age)) if age is not None else 0.0,
+                probe_in_flight=False,
+                exposure_s=num(entry["exposure_s"]),
+            )
+        return tracker

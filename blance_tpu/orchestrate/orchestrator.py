@@ -25,7 +25,7 @@ import inspect
 import random
 import warnings as _warnings
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Awaitable, Callable, Optional, Union
+from typing import TYPE_CHECKING, Any, Awaitable, Callable, Optional, Union
 
 from ..core.types import Partition, PartitionMap, PartitionModel
 from ..moves.calc import calc_partition_moves
@@ -197,6 +197,17 @@ class OrchestratorOptions:
     # move set stay bit-identical, only the order (and the clock)
     # changes.  Mutually exclusive with a custom find_move callback.
     scheduler: Optional[SchedulerPolicy] = None
+    # -- durability extension (docs/DURABILITY.md) --
+    # Fenced epoch for the journal directory this orchestration serves
+    # (durability/epoch.py EpochFence; duck-typed `current`/`valid` so
+    # this layer needs no durability import).  The orchestrator captures
+    # the epoch ONCE at construction and re-checks it at every batch
+    # completion: a callback resolving after a crash recovery bumped the
+    # fence is a zombie — its outcome is rejected and counted
+    # (durability.stale_epoch_rejections), never applied to the achieved
+    # map or shown to observers.  None disables fencing (the default:
+    # one-shot rebalances have no journal to protect).
+    epoch_fence: Optional[Any] = None
 
 
 @dataclass
@@ -389,6 +400,11 @@ class Orchestrator:
         else:
             self.health = None
         self._retry_rng = random.Random(options.retry_seed)
+        # Fenced epoch, captured ONCE: if a crash recovery bumps the
+        # fence mid-flight, every later completion in this run reads as
+        # stale and is rejected (see _mover_loop).
+        self._epoch = (options.epoch_fence.current
+                       if options.epoch_fence is not None else 0)
         self._missing_mover_warned: set[str] = set()
         # Set by the supplier AFTER the progress channel closes: the
         # whole wind-down (movers exited, feeders resolved) is complete.
@@ -736,11 +752,25 @@ class Orchestrator:
                         "tot_mover_assign_partition_err" if err is not None
                         else "tot_mover_assign_partition_ok")
 
+            # Epoch fencing (docs/DURABILITY.md): a completion observed
+            # after a crash recovery bumped the journal's fence is a
+            # ZOMBIE — this whole orchestrator predates the recovery.
+            # The outcome is rejected and counted, never applied: no
+            # observer sees it (the successor's journal/SLO view stays
+            # the truth) and the error marks the cursor failed, so
+            # achieved_map() never includes the move.
+            fence = self.options.epoch_fence
+            if fence is not None and not fence.valid(self._epoch):
+                from ..durability.epoch import StaleEpochError
+                self._rec.count("durability.stale_epoch_rejections")
+                err = StaleEpochError(
+                    f"move batch on node {node!r}", self._epoch,
+                    fence.current)
             # SLO / cost-model hook: every batch outcome, success or
             # failure, with the recorder-clock timestamp.  Observers are
             # sync (no await): the placement-view update is atomic on
             # the loop, so concurrent movers cannot tear it.
-            if self._observers:
+            elif self._observers:
                 t_done = self._rec.now()
                 for observer in self._observers:
                     observer.on_batch(node, req.partition_moves,

@@ -27,8 +27,6 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import json
-import os
-import tempfile
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Iterable, Mapping, Optional
 
@@ -57,9 +55,11 @@ from .orchestrate.orchestrator import (
 )
 from .plan.api import plan_next_map
 from .plan.greedy import sort_state_names
+from .utils.atomicio import atomic_write_json
 from .utils.trace import PhaseTimer
 
 if TYPE_CHECKING:  # annotation-only
+    from .durability.journal import JournalFeed
     from .plan.session import PlannerSession
 
 __all__ = [
@@ -182,41 +182,16 @@ class RebalanceResult:
 
 
 def save_partition_map(pmap: PartitionMap, path: str) -> None:
-    """Checkpoint a map as JSON, atomically.
+    """Checkpoint a map as JSON, atomically and durably.
 
-    A crash mid-write must never leave a torn checkpoint: the JSON goes
-    to a uniquely-named temp file IN THE SAME DIRECTORY (os.replace is
-    only atomic within a filesystem), is fsync'd so the rename cannot be
-    reordered before the data blocks, then os.replace'd into place.  A
-    failure on any step removes the temp file and re-raises — the
-    previous checkpoint survives untouched.
+    One of the three users of the shared crash-atomic write recipe in
+    :mod:`blance_tpu.utils.atomicio` (same-dir temp + file fsync +
+    rename + DIRECTORY fsync); a crash mid-write leaves the previous
+    checkpoint untouched, and a power failure after return cannot lose
+    the rename.  The checkpoint's mode is preserved (umask default for
+    a fresh file) so unprivileged readers keep working.
     """
-    directory = os.path.dirname(os.path.abspath(path)) or "."
-    fd, tmp = tempfile.mkstemp(
-        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory)
-    try:
-        # mkstemp creates 0600; os.replace would carry that restrictive
-        # mode onto the checkpoint and break unprivileged readers
-        # (monitoring, backups).  Preserve the existing checkpoint's
-        # mode, or umask-default for a fresh one.
-        try:
-            mode = os.stat(path).st_mode & 0o777
-        except FileNotFoundError:
-            umask = os.umask(0)
-            os.umask(umask)
-            mode = 0o666 & ~umask
-        os.fchmod(fd, mode)
-        with os.fdopen(fd, "w") as f:
-            json.dump(partition_map_to_json(pmap), f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    atomic_write_json(path, partition_map_to_json(pmap))
 
 
 def load_partition_map(path: str) -> PartitionMap:
@@ -602,6 +577,7 @@ class RebalanceController(CycleEngine):
         max_passes_per_cycle: int = 8,
         slo: Optional[SloTracker] = None,
         move_observers: tuple = (),
+        journal: "Optional[JournalFeed]" = None,
     ) -> None:
         if session is not None and planner is not None:
             raise ValueError(
@@ -661,6 +637,22 @@ class RebalanceController(CycleEngine):
         # the simulator's event log needs every strip to make the SLO
         # account recomputable from the log alone.
         self.on_strip: list[Callable[[set[str], float], None]] = []
+        # Durability feed (durability/journal.py, docs/DURABILITY.md):
+        # every sync window writes a WAL record — delta intake
+        # (_on_submit), cycle begin (_on_cycle), plan landed
+        # (_converge), executed-batch achieved-map delta (the journal
+        # rides _observers as a MoveObserver), strips, and quiesce
+        # (plus a periodic snapshot at that idle edge).  The genesis
+        # record below makes recovery self-contained before the first
+        # snapshot.
+        self._journal = journal
+        if journal is not None:
+            journal.record_genesis(
+                self.current, self._nodes, self._removing, self._failed,
+                self._pweights, self._nweights, t=self._rec.now())
+            self._observers = self._observers + (journal,)
+            self.on_strip.append(
+                lambda nodes, t: journal.record_strip(sorted(nodes), t=t))
 
     # -- CycleEngine hooks (sync: single atomic windows) -------------------
 
@@ -671,6 +663,12 @@ class RebalanceController(CycleEngine):
             # the next quiesce closes it with the time-to-last-required
             # -move sample, slo.first_converged_lag_s).
             self._slo.open_incident(self._rec.now())
+        if self._journal is not None:
+            self._journal.record_delta(delta, t=self._rec.now())
+
+    def _on_cycle(self, n: int, deltas: int) -> None:
+        if self._journal is not None:
+            self._journal.record_cycle(n, deltas, t=self._rec.now())
 
     def _on_stop_soon(self) -> None:
         # Wind-down cancels any in-flight transition.
@@ -681,6 +679,13 @@ class RebalanceController(CycleEngine):
     def _on_idle(self, t: float) -> None:
         if self._slo is not None:
             self._slo.close_incident(t)
+        if self._journal is not None:
+            # Quiesce record (map digest: the cheap divergence probe),
+            # then maybe a snapshot — written at the idle edge so a
+            # snapshot never captures a mid-cycle map.
+            self._journal.record_quiesce_map(self.current, t=t)
+            if self._journal.should_snapshot():
+                self._journal.write_snapshot(self.snapshot_payload(t), t=t)
 
     def _on_exit(self) -> None:
         if self._slo is not None and not self._idle.is_set():
@@ -700,6 +705,31 @@ class RebalanceController(CycleEngine):
     def quarantined_nodes(self) -> list[str]:
         return self.health.quarantined_nodes() \
             if self.health is not None else []
+
+    def snapshot_payload(self, t: float) -> dict:
+        """The controller's durable state for one snapshot
+        (durability/recover.py SNAPSHOT_FORMAT_VERSION): map +
+        membership view + weights, HealthTracker state (open exposure
+        intervals included), SloTracker horizon state, and the
+        scheduler's CostModel aggregates when one is wired.  Carry /
+        encode caches are deliberately absent — recovery demotes them
+        to counted cold solves (docs/DURABILITY.md)."""
+        cost = getattr(self.orch_opts.scheduler, "cost_model", None)
+        return {
+            "version": 1,
+            "map": {name: p.to_json()
+                    for name, p in sorted(self.current.items())},
+            "nodes": list(self._nodes),
+            "removing": sorted(self._removing),
+            "failed": sorted(self._failed),
+            "pweights": dict(sorted(self._pweights.items())),
+            "nweights": dict(sorted(self._nweights.items())),
+            "health": (self.health.to_dict(t)
+                       if self.health is not None else None),
+            "slo": (self._slo.to_dict(t)
+                    if self._slo is not None else None),
+            "cost": cost.to_json() if cost is not None else None,
+        }
 
     def live_nodes(self) -> list[str]:
         """Nodes currently eligible as placement candidates (known,
@@ -918,8 +948,9 @@ class RebalanceController(CycleEngine):
                 self._rec.count("sim.degraded_plans")
             if next_map is None:
                 break
-            if count_moves(self.model, self.current, next_map,
-                           self.orch_opts.favor_min_nodes) == 0:
+            n_moves = count_moves(self.model, self.current, next_map,
+                                  self.orch_opts.favor_min_nodes)
+            if n_moves == 0:
                 if self.session is not None and \
                         _maps_equal(self.current, next_map):
                     # Fixpoint reached with the proposal == current:
@@ -929,6 +960,9 @@ class RebalanceController(CycleEngine):
             passes += 1
             self.passes += 1
             self._rec.count("sim.rebalances")
+            if self._journal is not None:
+                self._journal.record_plan(passes, n_moves,
+                                          t=self._rec.now())
             superseded, failures = await self._one_pass(next_map)
             if superseded:
                 return
@@ -959,6 +993,13 @@ class RebalanceController(CycleEngine):
         opts = self.orch_opts
         if self.health is not None:
             opts = dataclasses.replace(opts, health=self.health)
+        if self._journal is not None and opts.epoch_fence is None:
+            # Every dispatched move is stamped with the journal dir's
+            # fenced epoch: a completion arriving after a recovery
+            # bumped the fence is rejected and counted, never applied
+            # (durability.stale_epoch_rejections).
+            opts = dataclasses.replace(opts,
+                                       epoch_fence=self._journal.fence)
         o = orchestrate_moves(
             self.model, opts, self._mover_nodes(), self.current, next_map,
             self._assign, self._find_move, move_observers=self._observers)

@@ -41,6 +41,10 @@ __all__ = [
     "hetero_drain",
     "mixed_week",
     "SCENARIOS",
+    "CrashScenario",
+    "crash_smoke",
+    "crash_storm",
+    "CRASH_SCENARIOS",
     "FleetTenant",
     "FleetEvent",
     "FleetScenario",
@@ -371,6 +375,99 @@ SCENARIOS: dict[str, Callable[[int], SimScenario]] = {
     "weight_drift": weight_drift,
     "hetero_drain": hetero_drain,
     "mixed_week": mixed_week,
+}
+
+
+# -- crash scenarios (blance_tpu/testing/crashsim.py) -------------------------
+#
+# A CrashScenario scripts controller process deaths on top of a small
+# SimScenario: ``crashes[i]`` is the journal-record boundary life i
+# dies at (a life past the end of the chain runs crash-free).  The
+# crash harness recovers each death from the WAL and asserts the run
+# still converges to the crash-free reference's final map
+# bit-identically (docs/DURABILITY.md "Crash injection").
+
+
+@dataclass(frozen=True)
+class CrashScenario:
+    """A cluster life plus its scripted crash chain."""
+
+    name: str
+    seed: int
+    base: SimScenario
+    crashes: tuple[int, ...]
+    snapshot_every: int = 0
+    rotate_records: int = 64
+
+
+def crash_smoke(seed: int = 17) -> SimScenario:
+    """The bounded-exhaustive crash target: a DELIBERATELY small life
+    (one outage, one return, one graceful retire — every membership
+    fold path) so crashing at every journal-record boundary stays a
+    smoke-test-sized matrix."""
+    rng = random.Random(f"crash:{seed}")
+    nodes = ("n0", "n1", "n2", "n3")
+    events = (
+        SimEvent(t=_jitter(rng, 60, 5),
+                 delta=ClusterDelta(fail=("n1",)),
+                 label="fail-n1", outage=True),
+        SimEvent(t=_jitter(rng, 180, 5),
+                 delta=ClusterDelta(add=("n1",)),
+                 label="return-n1"),
+        SimEvent(t=_jitter(rng, 300, 5),
+                 delta=ClusterDelta(remove=("n0",)),
+                 label="retire-n0"),
+    )
+    return SimScenario(
+        name="crash_smoke", seed=seed, horizon_s=480.0,
+        nodes=nodes, partitions=8, replicas=1, events=events,
+        availability_floor=0.5, base_latency_s=1.0, debounce_s=0.5,
+        move_timeout_s=30.0, max_retries=0, quarantine_after=0)
+
+
+def crash_storm(seed: int = 19) -> CrashScenario:
+    """Repeated controller crash-restarts landing mid-incident: the
+    first death falls inside the outage's converge cycle, the second
+    inside the window where a graceful retire OVERLAPS the outage
+    rebalance (a supersede in flight), the third late in the life.
+    Snapshots are on, so later recoveries exercise the snapshot
+    fast-forward + post-snapshot replay path, not just raw folds."""
+    rng = random.Random(f"storm:{seed}")
+    nodes = _zone_nodes(2, 3)  # 6 nodes
+    t_fail = _jitter(rng, 90, 5)
+    events = (
+        SimEvent(t=t_fail, delta=ClusterDelta(fail=(nodes[0],)),
+                 label="zone-fail", outage=True),
+        # The retire lands seconds into the outage rebalance — a
+        # supersede, not a fresh cycle (mixed_week's overlap pattern).
+        SimEvent(t=round(t_fail + rng.uniform(2.0, 6.0), 3),
+                 delta=ClusterDelta(remove=(nodes[1],)),
+                 label="retire-overlapping"),
+        SimEvent(t=_jitter(rng, 300, 10),
+                 delta=ClusterDelta(add=(nodes[0],)),
+                 label="zone-returns"),
+        SimEvent(t=_jitter(rng, 420, 10),
+                 delta=ClusterDelta(partition_weights={"p0000": 8}),
+                 label="hot-partition"),
+    )
+    base = SimScenario(
+        name="crash_storm", seed=seed, horizon_s=600.0,
+        nodes=nodes, partitions=12, replicas=1, events=events,
+        availability_floor=0.5, base_latency_s=1.0, debounce_s=0.5,
+        move_timeout_s=30.0, max_retries=0, quarantine_after=0)
+    # Boundaries drawn at build time (determinism contract): the first
+    # two land inside the incident/supersede convergence records, the
+    # third well into the recovered life's tail.
+    crashes = (rng.randint(6, 10), rng.randint(10, 16),
+               rng.randint(22, 30))
+    return CrashScenario(
+        name="crash_storm", seed=seed, base=base, crashes=crashes,
+        snapshot_every=8)
+
+
+# Crash scenario-family registry: name -> builder(seed).
+CRASH_SCENARIOS: dict[str, Callable[[int], CrashScenario]] = {
+    "crash_storm": crash_storm,
 }
 
 

@@ -598,6 +598,8 @@ def test_race_lint_real_package_model_matches_reality():
     from blance_tpu.analysis.race_lint import SHARED_STATE
 
     import blance_tpu.control as control
+    import blance_tpu.durability.epoch as depoch
+    import blance_tpu.durability.journal as djournal
     import blance_tpu.fleetloop as fleetloop
 
     # `import blance_tpu.rebalance as ...` would resolve to the
@@ -626,6 +628,8 @@ def test_race_lint_real_package_model_matches_reality():
             rebalance.RebalanceController),
         "_CriticalPathBound": inspect.getsource(
             schedpolicy._CriticalPathBound),
+        "Journal": inspect.getsource(djournal.Journal),
+        "EpochFence": inspect.getsource(depoch.EpochFence),
     }
     for cls, attrs in SHARED_STATE.items():
         src = sources[cls]

@@ -1,0 +1,216 @@
+"""Crash-injection tier (docs/DURABILITY.md "Crash injection"): the
+headline acceptance for the durable control plane.
+
+Covers: the bounded-exhaustive crash matrix — one deterministic run per
+journal-record boundary of the ``crash_smoke`` scenario, each restart
+recovering from the WAL and converging to the crash-free reference's
+final map bit-identically; the ``crash_storm`` multi-crash chain
+(restarts landing mid-incident, including one during an overlapping
+supersede) with its committed byte-identical replay trace; and a fleet
+crash/resume round-trip (shared tenant-tagged WAL, per-tenant
+``resume_tenant``).  The harness lives in testing/crashsim.py; the
+mechanism-level durability tests (framing, torn tails, fencing,
+round-trips) in tests/test_durability.py.
+"""
+
+import asyncio
+
+import pytest
+
+from blance_tpu.core.types import Partition, model
+from blance_tpu.durability import Journal, recover, reset_fences
+from blance_tpu.fleetloop import FleetController
+from blance_tpu.obs import Recorder, use_recorder
+from blance_tpu.rebalance import ClusterDelta
+from blance_tpu.testing.crashsim import (
+    crash_matrix,
+    maps_identical,
+    run_crash_scenario,
+)
+from blance_tpu.testing.scenarios import crash_smoke, crash_storm
+from blance_tpu.testing.sched import DeterministicLoop, FifoPolicy
+
+CRASH_TRACE_PATH = "tests/traces/crash_storm_s19.json"
+
+
+@pytest.fixture(autouse=True)
+def _crash_env(monkeypatch):
+    """Virtual-time crash runs hammer the journal: gate fsync off
+    (atomicity and replay order still fully exercised) and isolate the
+    process-level fence registry per test."""
+    monkeypatch.setenv("BLANCE_WAL_FSYNC", "0")
+    reset_fences()
+    yield
+    reset_fences()
+
+
+# -- determinism --------------------------------------------------------------
+
+
+def test_crash_run_bit_identical_across_runs(tmp_path):
+    """Same scenario + same crash boundaries => byte-identical event
+    log and identical final map — the determinism contract that makes
+    every crash reproducible from its trace line."""
+    scn = crash_smoke(17)
+    a = run_crash_scenario(scn, str(tmp_path / "a"), crashes=(9,))
+    b = run_crash_scenario(scn, str(tmp_path / "b"), crashes=(9,))
+    assert a.log_text() == b.log_text()
+    assert maps_identical(a.final_map, b.final_map)
+    assert a.lives == 2
+    # A different boundary is a genuinely different trace.
+    c = run_crash_scenario(scn, str(tmp_path / "c"), crashes=(10,))
+    assert c.log_text() != a.log_text()
+
+
+# -- the headline acceptance: bounded-exhaustive crash injection --------------
+
+
+def test_exhaustive_crash_matrix_recovers_bit_identically(tmp_path):
+    """Crash at EVERY journal-record boundary of crash_smoke (including
+    boundary 0 — the genesis record itself lost): each restart recovers
+    from the WAL, redelivers the non-durable events, and converges to
+    the crash-free reference's final map bit-identically."""
+    scn = crash_smoke(17)
+    ref, runs = crash_matrix(scn, str(tmp_path))
+    assert ref.records_first_life >= 20  # the matrix is a real sweep
+    assert len(runs) == ref.records_first_life
+    for k, report in runs:
+        assert report.lives == 2, f"boundary {k}: {report.lives} lives"
+        assert maps_identical(report.final_map, ref.final_map), (
+            f"crash at record boundary {k} recovered to a DIFFERENT "
+            f"final map than the crash-free reference")
+        assert report.counters.get("durability.recoveries") == 1
+        assert report.counters.get(
+            "durability.recovery_cold_solves", 0) <= 1
+
+
+@pytest.mark.slow
+def test_exhaustive_crash_matrix_with_snapshots(tmp_path):
+    """The same sweep with a tight snapshot cadence and small segments:
+    every boundary now also lands around snapshot pointers and segment
+    rotations, exercising the fast-forward restore path."""
+    scn = crash_smoke(17)
+    ref, runs = crash_matrix(scn, str(tmp_path), snapshot_every=4,
+                             rotate_records=8)
+    for k, report in runs:
+        assert maps_identical(report.final_map, ref.final_map), (
+            f"crash at boundary {k} (snapshot cadence 4) diverged")
+
+
+# -- crash_storm: multi-crash chain + committed trace -------------------------
+
+
+def test_crash_storm_chain_converges_to_reference(tmp_path):
+    """Three controller crash-restarts landing mid-incident (one during
+    the overlapping supersede): the chain recovers each time and ends
+    on the crash-free reference's exact final map, with every recovery
+    and cold solve counted."""
+    cs = crash_storm(19)
+    ref = run_crash_scenario(cs.base, str(tmp_path / "ref"))
+    storm = run_crash_scenario(
+        cs.base, str(tmp_path / "storm"), crashes=cs.crashes,
+        snapshot_every=cs.snapshot_every,
+        rotate_records=cs.rotate_records)
+    assert storm.lives == len(cs.crashes) + 1
+    assert maps_identical(storm.final_map, ref.final_map)
+    assert storm.counters["durability.recoveries"] == len(cs.crashes)
+    assert storm.counters["durability.recovery_cold_solves"] == \
+        len(cs.crashes)
+    assert storm.counters["durability.snapshots"] >= 1
+
+
+def test_committed_crash_storm_trace_replays_exactly(tmp_path):
+    """The committed crash_storm trace regenerates byte-for-byte — any
+    drift in journal framing, recovery folding, clock re-basing or the
+    harness itself shows up as a diff here and must be understood
+    (then the trace regenerated)."""
+    with open(CRASH_TRACE_PATH) as f:
+        committed = f.read()
+    cs = crash_storm(19)
+    live = run_crash_scenario(
+        cs.base, str(tmp_path), crashes=cs.crashes,
+        snapshot_every=cs.snapshot_every,
+        rotate_records=cs.rotate_records).log_text()
+    assert live == committed, (
+        "crash-recovery behavior drifted from the committed trace "
+        f"({CRASH_TRACE_PATH}); if the change is intended, regenerate: "
+        "env BLANCE_WAL_FSYNC=0 python -c \"import tempfile; "
+        "from blance_tpu.testing.scenarios import crash_storm; "
+        "from blance_tpu.testing.crashsim import run_crash_scenario; "
+        "cs = crash_storm(19); open('" + CRASH_TRACE_PATH + "', 'w')"
+        ".write(run_crash_scenario(cs.base, tempfile.mkdtemp(), "
+        "crashes=cs.crashes, snapshot_every=cs.snapshot_every, "
+        "rotate_records=cs.rotate_records).log_text())\"")
+
+
+# -- fleet crash/resume -------------------------------------------------------
+
+M = model(primary=(0, 1))
+
+
+def _pmap():
+    return {f"p{i}": Partition(f"p{i}", {"primary": ["n0"]})
+            for i in range(4)}
+
+
+def _nbs(maps):
+    return {k: {n: {s: list(ns) for s, ns in p.nodes_by_state.items()}
+                for n, p in m.items()} for k, m in maps.items()}
+
+
+async def _assign(stop_ch, node, partitions, states, ops):
+    await asyncio.sleep(0)
+
+
+def test_fleet_crash_resume_round_trip(tmp_path):
+    """Two tenant loops journaling through one shared tenant-tagged WAL
+    (plus untagged fleet-tier membership records): kill the fleet after
+    convergence, recover the journal, resume_tenant each loop in a
+    FRESH process (new virtual loop, clock restarted at zero) — the
+    resumed fleet quiesces to bit-identical per-tenant maps."""
+    journal_dir = str(tmp_path)
+    loop = DeterministicLoop(FifoPolicy())
+    rec = Recorder(clock=loop.time)
+
+    async def first_life():
+        with use_recorder(rec):
+            j = Journal(journal_dir, clock=loop.time, snapshot_every=6)
+            fc = FleetController(["n0", "n1", "n2"], inline_solve=True,
+                                 recorder=rec, debounce_s=0.01,
+                                 journal=j)
+            await fc.start()
+            for key in ("ta", "tb"):
+                fc.add_tenant(key, M, _pmap(), _assign)
+            fc.submit_all(ClusterDelta(fail=("n0",)))
+            maps = await fc.quiesce_all()
+            await fc.stop()
+            j.close()
+            return maps
+
+    maps1 = loop.run_until_complete(first_life())
+
+    loop2 = DeterministicLoop(FifoPolicy())
+    rec2 = Recorder(clock=loop2.time)
+
+    async def second_life():
+        with use_recorder(rec2):
+            st = recover(journal_dir, clock=loop2.time)
+            assert sorted(k for k in st.tenants if k is not None) == \
+                ["ta", "tb"]
+            fc = FleetController(["n0", "n1", "n2"], inline_solve=True,
+                                 recorder=rec2, debounce_s=0.01,
+                                 journal=st.journal)
+            await fc.start()
+            for key in ("ta", "tb"):
+                fc.resume_tenant(st, key, M, _assign)
+            maps = await fc.quiesce_all()
+            await fc.stop()
+            st.journal.close()
+            return maps
+
+    maps2 = loop2.run_until_complete(second_life())
+    assert _nbs(maps1) == _nbs(maps2)
+    # The resume's cold solves stay inside the attribution bound: at
+    # most one counted cold solve per resumed tenant.
+    assert rec2.counters["durability.recoveries"] == 1
+    assert rec2.counters["durability.recovery_cold_solves"] <= 2

@@ -1,0 +1,541 @@
+"""Durability tier: atomic writes, the WAL, torn tails, epoch fencing,
+snapshot/restore round-trips, and recovery folding (docs/DURABILITY.md).
+
+Covers the crash-atomic write recipe (utils/atomicio.py — the one shared
+copy of temp+fsync+rename with the directory fsync'd too), journal
+framing/CRC/rotation, torn-tail truncation to the last valid prefix
+(counted, never a crash loop), in-process and cross-process epoch
+fencing, HealthTracker/SloTracker serialization round-trips (clock
+re-based), and recover()'s per-tenant fold.  The end-to-end
+crash-injection matrix lives in tests/test_crash.py.
+"""
+
+import json
+import os
+import random
+import zlib
+
+import pytest
+
+from blance_tpu.core.types import Partition
+from blance_tpu.durability.epoch import (
+    EPOCH_FILE,
+    EpochFence,
+    fence_for,
+    reset_fences,
+)
+from blance_tpu.durability.journal import (
+    Journal,
+    encode_record,
+    list_segments,
+    map_digest,
+    read_journal,
+    read_segment,
+)
+from blance_tpu.durability.recover import recover
+from blance_tpu.obs import Recorder, use_recorder
+from blance_tpu.obs.slo import SloTracker
+from blance_tpu.orchestrate.health import (
+    HALF_OPEN,
+    HEALTHY,
+    QUARANTINED,
+    HealthTracker,
+)
+from blance_tpu.utils.atomicio import atomic_write_json, atomic_write_text
+
+
+@pytest.fixture(autouse=True)
+def _durability_env(monkeypatch):
+    """Fast, isolated durability tests: fsync gated off (atomicity and
+    rename ordering still exercised — only the disk barrier is skipped)
+    and the process-level fence registry cleared between tests."""
+    monkeypatch.setenv("BLANCE_WAL_FSYNC", "0")
+    reset_fences()
+    yield
+    reset_fences()
+
+
+def _pmap(d):
+    return {name: Partition(name, {s: list(ns) for s, ns in nbs.items()})
+            for name, nbs in d.items()}
+
+
+# -- atomicio: the one copy of the crash-atomic recipe -----------------------
+
+
+def test_atomic_write_text_creates_and_replaces(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_text(path, "first")
+    assert open(path).read() == "first"
+    atomic_write_text(path, "second")
+    assert open(path).read() == "second"
+    # No temp litter: the rename consumed it.
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+def test_atomic_write_preserves_target_mode(tmp_path):
+    """mkstemp creates 0600; the recipe must re-stamp the EXISTING
+    target's mode or unprivileged readers of a world-readable
+    checkpoint break after the first rewrite."""
+    path = str(tmp_path / "map.json")
+    atomic_write_text(path, "v1")
+    os.chmod(path, 0o644)
+    atomic_write_text(path, "v2")
+    assert os.stat(path).st_mode & 0o777 == 0o644
+    # A fresh file gets the umask default, not mkstemp's 0600.
+    fresh = str(tmp_path / "fresh.json")
+    atomic_write_text(fresh, "x")
+    umask = os.umask(0)
+    os.umask(umask)
+    assert os.stat(fresh).st_mode & 0o777 == (0o666 & ~umask)
+
+
+def test_atomic_write_failure_leaves_previous_file(tmp_path):
+    """Any failure before the rename must leave the old bytes intact
+    and unlink the temp — the previous checkpoint survives."""
+    path = str(tmp_path / "snap.json")
+    atomic_write_json(path, {"ok": 1})
+    with pytest.raises(TypeError):
+        atomic_write_json(path, {"bad": object()})
+    assert json.load(open(path)) == {"ok": 1}
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+def test_atomic_write_json_matches_plain_dump(tmp_path):
+    path = str(tmp_path / "j.json")
+    obj = {"b": [1, 2], "a": {"x": None}}
+    atomic_write_json(path, obj, sort_keys=True)
+    assert open(path).read() == json.dumps(obj, sort_keys=True)
+
+
+# -- journal framing ---------------------------------------------------------
+
+
+def test_encode_record_is_canonical_and_crc_framed():
+    line = encode_record(7, 2, "delta", 1.5, "t0", {"b": 1, "a": 2})
+    crc_hex, payload = line[:8], line[9:-1]
+    assert line.endswith("\n") and line[8] == " "
+    assert int(crc_hex, 16) == zlib.crc32(payload.encode()) & 0xFFFFFFFF
+    # Canonical JSON: sorted keys, no whitespace — byte-stable framing.
+    assert payload == json.dumps(json.loads(payload), sort_keys=True,
+                                 separators=(",", ":"))
+    obj = json.loads(payload)
+    assert (obj["seq"], obj["epoch"], obj["kind"], obj["tenant"]) == \
+        (7, 2, "delta", "t0")
+
+
+def test_map_digest_ignores_dict_order():
+    a = _pmap({"p0": {"primary": ["n0"]}, "p1": {"primary": ["n1"]}})
+    b = dict(reversed(list(a.items())))
+    assert map_digest(a) == map_digest(b)
+    c = _pmap({"p0": {"primary": ["n1"]}, "p1": {"primary": ["n1"]}})
+    assert map_digest(a) != map_digest(c)
+
+
+def test_journal_appends_replay_in_order(tmp_path):
+    j = Journal(str(tmp_path), clock=lambda: 3.0)
+    j.append("genesis", {"n": 0})
+    j.append("delta", {"n": 1})
+    j.append("quiesce", {"n": 2}, t=9.0)
+    j.close()
+    records, stats = read_journal(str(tmp_path))
+    assert [r.kind for r in records] == ["genesis", "delta", "quiesce"]
+    assert [r.seq for r in records] == [1, 2, 3]
+    assert [r.t for r in records] == [3.0, 3.0, 9.0]
+    assert stats.torn_segments == 0 and stats.stale_dropped == 0
+
+
+def test_journal_rotation_is_seamless(tmp_path):
+    rec = Recorder()
+    with use_recorder(rec):
+        j = Journal(str(tmp_path), rotate_records=2)
+        for i in range(5):
+            j.append("delta", {"i": i})
+        j.close()
+    segs = list_segments(str(tmp_path))
+    assert len(segs) == 3
+    # Indices globally monotone == replay order.
+    assert [index for index, _epoch, _name in segs] == [1, 2, 3]
+    records, _stats = read_journal(str(tmp_path))
+    assert [r.data["i"] for r in records] == list(range(5))
+    assert [r.seq for r in records] == [1, 2, 3, 4, 5]
+    assert rec.counters["durability.segments_rotated"] == 2
+    assert rec.counters["durability.journal_records"] == 5
+
+
+def test_journal_bytes_are_pure_function_of_content(tmp_path):
+    """Same appends => byte-identical segments — the determinism the
+    committed crash traces stand on."""
+    def write(d):
+        j = Journal(str(d), clock=lambda: 1.0)
+        j.append("genesis", {"map": {"p0": {"primary": ["a"]}}})
+        j.append("delta", {"add": ["b"]})
+        j.close()
+        name = list_segments(str(d))[0][2]
+        return open(os.path.join(str(d), name), "rb").read()
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    assert write(a) == write(b)
+
+
+# -- torn tails --------------------------------------------------------------
+
+
+def _seg_path(journal_dir):
+    name = list_segments(journal_dir)[0][2]
+    return os.path.join(journal_dir, name)
+
+
+def _write_three(journal_dir):
+    j = Journal(journal_dir)
+    j.append("delta", {"n": 0})
+    j.append("delta", {"n": 1})
+    j.append("delta", {"n": 2})
+    j.close()
+
+
+def test_truncated_final_record_recovers_prefix(tmp_path):
+    """Power loss mid-append: the half-written final record is dropped,
+    replay continues from the last valid prefix, counted once."""
+    _write_three(str(tmp_path))
+    path = _seg_path(str(tmp_path))
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-7])  # tear the last record mid-line
+    rec = Recorder()
+    with use_recorder(rec):
+        records, stats = read_journal(str(tmp_path))
+    assert [r.data["n"] for r in records] == [0, 1]
+    assert stats.torn_segments == 1
+    assert rec.counters["durability.torn_tail"] == 1
+
+
+def test_missing_trailing_newline_is_torn_even_if_parseable(tmp_path):
+    _write_three(str(tmp_path))
+    path = _seg_path(str(tmp_path))
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-1])  # exact bytes, newline lost
+    records, stats = read_journal(str(tmp_path))
+    assert [r.data["n"] for r in records] == [0, 1]
+    assert stats.torn_segments == 1
+
+
+def test_crc_corrupted_record_truncates_to_prefix(tmp_path):
+    """A flipped bit mid-file fails the CRC; the record AND everything
+    after it are dropped (prefix semantics — order is meaningless past
+    a gap), and recovery still proceeds: no crash loop."""
+    _write_three(str(tmp_path))
+    path = _seg_path(str(tmp_path))
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    corrupt = lines[1][:12] + b"X" + lines[1][13:]
+    open(path, "wb").write(lines[0] + corrupt + lines[2])
+    rec = Recorder()
+    with use_recorder(rec):
+        records, torn = read_segment(path)
+        assert [r.data["n"] for r in records] == [0]
+        assert torn
+        state = recover(str(tmp_path))
+    assert state.torn_segments == 1
+    assert state.records_replayed == 1
+    assert rec.counters["durability.torn_tail"] == 1
+    assert rec.counters["durability.recoveries"] == 1
+
+
+def test_empty_and_garbage_segments_do_not_block_recovery(tmp_path):
+    j = Journal(str(tmp_path))
+    j.append("delta", {"n": 0})
+    j.close()
+    open(os.path.join(str(tmp_path), "wal-000000-000002.log"),
+         "wb").write(b"not a journal record at all\n")
+    records, stats = read_journal(str(tmp_path))
+    assert [r.data["n"] for r in records] == [0]
+    assert stats.torn_segments == 1
+
+
+# -- snapshots ---------------------------------------------------------------
+
+
+def test_snapshot_pointer_written_after_file(tmp_path):
+    j = Journal(str(tmp_path), tenant="t0", snapshot_every=2)
+    assert not j.should_snapshot()
+    j.append("delta", {"n": 1})
+    j.append("delta", {"n": 2})
+    assert j.should_snapshot()
+    name = j.write_snapshot({"version": 1, "x": 42})
+    assert not j.should_snapshot()  # cadence counter reset
+    j.close()
+    assert json.load(open(os.path.join(str(tmp_path), name)))["x"] == 42
+    records, _stats = read_journal(str(tmp_path))
+    assert records[-1].kind == "snapshot"
+    assert records[-1].data["file"] == name
+    assert records[-1].tenant == "t0"
+
+
+def test_missing_snapshot_file_never_blocks_recovery(tmp_path):
+    """Defense in depth: a pointer whose file is gone (or torn) is
+    skipped and the fold continues from what it already has."""
+    j = Journal(str(tmp_path))
+    j.record_genesis(_pmap({"p0": {"primary": ["a"]}}), ["a"], [], [],
+                     {}, {})
+    j.append("snapshot", {"file": "snap-does-not-exist.json"})
+    j.close()
+    state = recover(str(tmp_path))
+    t0 = state.tenants[None]
+    assert sorted(t0.pmap) == ["p0"]
+    assert t0.nodes == ["a"]
+
+
+# -- epoch fencing -----------------------------------------------------------
+
+
+def test_recover_bumps_and_persists_epoch(tmp_path):
+    j = Journal(str(tmp_path))
+    j.append("delta", {"n": 0})
+    j.close()
+    state = recover(str(tmp_path))
+    assert state.epoch == 1
+    assert json.load(
+        open(os.path.join(str(tmp_path), EPOCH_FILE)))["epoch"] == 1
+    # The persisted epoch survives a registry wipe (a "new process").
+    reset_fences()
+    assert fence_for(str(tmp_path)).current == 1
+    state2 = recover(str(tmp_path))
+    assert state2.epoch == 2
+
+
+def test_in_process_zombie_append_dropped_and_counted(tmp_path):
+    """A journal handle that predates a recovery shares the bumped
+    fence object: every further append is dropped, counted, and
+    reported False — the zombie cannot write at all."""
+    rec = Recorder()
+    with use_recorder(rec):
+        zombie = Journal(str(tmp_path))
+        assert zombie.append("delta", {"n": 0})
+        recover(str(tmp_path))
+        assert not zombie.append("delta", {"n": 1})
+        assert not zombie.append("delta", {"n": 2})
+    assert rec.counters["durability.stale_epoch_rejections"] == 2
+
+
+def test_cross_process_zombie_truncated_by_fence_record(tmp_path):
+    """A stale WRITER IN ANOTHER PROCESS (simulated with a private
+    fence object the recovery bump cannot reach) keeps appending to its
+    old segment after a recovery.  The fence record froze that
+    segment's valid count, so replay truncates the zombie's appends and
+    counts them — they are never part of recovered state."""
+    zombie = Journal(str(tmp_path), fence=EpochFence(str(tmp_path), 0))
+    zombie.append("delta", {"n": 0})
+    zombie.append("delta", {"n": 1})
+    recover(str(tmp_path))
+    # The zombie's private fence still says epoch 0 — its appends land.
+    assert zombie.append("delta", {"n": 99})
+    assert zombie.append("delta", {"n": 100})
+    zombie.close()
+    rec = Recorder()
+    with use_recorder(rec):
+        records, stats = read_journal(str(tmp_path))
+    assert [r.data.get("n") for r in records if r.kind != "fence"] == [0, 1]
+    assert stats.stale_dropped == 2
+    assert rec.counters["durability.stale_epoch_rejections"] == 2
+
+
+# -- health tracker round-trip ----------------------------------------------
+
+
+def test_health_round_trip_rebases_open_interval():
+    t = [100.0]
+    h = HealthTracker(threshold=2, probe_after_s=5.0, clock=lambda: t[0])
+    h.record_failure("n1")
+    h.record_failure("n1")  # trips at t=100
+    t[0] = 103.0  # 3s into the open interval
+    data = h.to_dict()
+    # Restore onto a NEW clock whose epoch is unrelated.
+    t2 = [7.0]
+    h2 = HealthTracker.from_dict(data, clock=lambda: t2[0])
+    assert h2.state("n1") == QUARANTINED
+    assert h2.exposure_s("n1") == pytest.approx(3.0)
+    # Dwell continues where the crash cut it: 2 more seconds => probe.
+    t2[0] = 9.0
+    assert h2.admit("n1") == "probe"
+    assert h2.record_success("n1")
+    assert h2.state("n1") == HEALTHY
+    assert h2.exposure_s("n1") == pytest.approx(5.0)
+
+
+def test_health_round_trip_drops_probe_in_flight():
+    """An in-flight probe died with the old process; restoring the flag
+    would wedge admission forever."""
+    t = [0.0]
+    h = HealthTracker(threshold=1, probe_after_s=1.0, clock=lambda: t[0])
+    h.record_failure("n1")
+    t[0] = 2.0
+    assert h.admit("n1") == "probe"  # probe_in_flight now True
+    h2 = HealthTracker.from_dict(h.to_dict(), clock=lambda: t[0])
+    assert h2.state("n1") == HALF_OPEN
+    assert h2.admit("n1") == "probe"  # fresh probe re-admitted
+
+
+def test_health_round_trip_property():
+    """Seeded random walks: after any prefix of breaker events, a
+    to_dict/from_dict round-trip onto a shifted clock preserves every
+    observable — states, exposures, trip counts — exactly."""
+    for seed in range(8):
+        rng = random.Random(seed)
+        t = [0.0]
+        h = HealthTracker(threshold=rng.randint(1, 3),
+                          probe_after_s=rng.uniform(0.5, 3.0),
+                          clock=lambda: t[0])
+        nodes = ["a", "b", "c"]
+        for _ in range(40):
+            t[0] += rng.uniform(0.0, 2.0)
+            node = rng.choice(nodes)
+            op = rng.random()
+            if op < 0.45:
+                h.record_failure(node)
+            elif op < 0.75:
+                h.record_success(node)
+            else:
+                h.admit(node)
+        shift = rng.uniform(-50.0, 50.0)
+        t2 = [t[0] + shift]
+        h2 = HealthTracker.from_dict(h.to_dict(), clock=lambda: t2[0])
+        assert {n: h2.state(n) for n in nodes} == \
+            {n: h.state(n) for n in nodes}
+        assert h2.total_trips() == h.total_trips()
+        for n in nodes:
+            assert h2.exposure_s(n) == pytest.approx(h.exposure_s(n))
+        # Double round-trip is exact (ages of ages).
+        h3 = HealthTracker.from_dict(h2.to_dict(), clock=lambda: t2[0])
+        assert h3.to_dict() == h2.to_dict()
+
+
+def test_health_from_dict_refuses_other_versions():
+    with pytest.raises(ValueError):
+        HealthTracker.from_dict({"version": 99, "threshold": 1,
+                                 "probe_after_s": 1.0, "nodes": {}})
+
+
+# -- slo tracker round-trip --------------------------------------------------
+
+
+class _Mv:
+    def __init__(self, partition, node, state, op):
+        self.partition, self.node = partition, node
+        self.state, self.op = state, op
+
+
+def test_slo_round_trip_preserves_account():
+    t = [0.0]
+    pmap = _pmap({"p0": {"primary": ["a"]}, "p1": {"primary": ["b"]}})
+    slo = SloTracker(pmap, clock=lambda: t[0], availability_floor=0.5,
+                     publish_gauges=False)
+    slo.set_min_moves(2)
+    t[0] = 1.0
+    slo.on_batch("b", [_Mv("p0", "b", "primary", "add")], True, t[0])
+    t[0] = 2.0
+    slo.on_batch("a", [_Mv("p0", "a", "", "del")], True, t[0])
+    t[0] = 5.0
+    data = slo.to_dict()
+    t2 = [1000.0]
+    slo2 = SloTracker.from_dict(data, clock=lambda: t2[0],
+                                publish_gauges=False)
+    s1, s2 = slo.summary(), slo2.summary()
+    assert s2.availability == s1.availability
+    assert s2.moves_executed == s1.moves_executed
+    assert s2.churn_ratio == s1.churn_ratio
+    assert s2.convergence_lag_s == pytest.approx(s1.convergence_lag_s)
+    assert s2.time_weighted_availability == \
+        pytest.approx(s1.time_weighted_availability)
+    # The horizon keeps integrating seamlessly on the new clock.
+    t2[0] = 1010.0
+    assert slo2.time_weighted_availability(t2[0]) == \
+        pytest.approx(slo.time_weighted_availability(15.0))
+
+
+def test_slo_from_dict_refuses_other_versions():
+    with pytest.raises(ValueError):
+        SloTracker.from_dict({"version": 0})
+
+
+# -- recovery folding --------------------------------------------------------
+
+
+def test_recover_folds_membership_and_batches(tmp_path):
+    j = Journal(str(tmp_path), clock=lambda: 0.0)
+    j.record_genesis(
+        _pmap({"p0": {"primary": ["a"]}, "p1": {"primary": ["b"]}}),
+        ["a", "b"], [], [], {"p0": 1, "p1": 1}, {"a": 1, "b": 1})
+
+    class _Delta:
+        add, remove, fail = ("c",), (), ("b",)
+        partition_weights, node_weights = {"p0": 3}, None
+
+    j.record_delta(_Delta())
+    j.record_strip(["b"])
+    j.on_batch("c", [_Mv("p1", "c", "primary", "add")], True, 4.0)
+    j.on_batch("c", [_Mv("p0", "c", "primary", "add")], False, 5.0)
+    j.record_quiesce_map(_pmap({"p0": {"primary": ["a"]},
+                                "p1": {"primary": ["c"]}}))
+    j.close()
+    state = recover(str(tmp_path))
+    t0 = state.tenants[None]
+    assert t0.nodes == ["a", "b", "c"]
+    assert t0.failed == {"b"} and t0.removing == set()
+    assert t0.pweights == {"p0": 3, "p1": 1}
+    # Strip removed b; the ok batch landed p1 on c; the failed batch
+    # did NOT mutate the map.
+    nbs = {name: p.nodes_by_state for name, p in t0.pmap.items()}
+    assert nbs["p0"] == {"primary": ["a"]}
+    assert nbs["p1"] == {"primary": ["c"]}
+    assert t0.quiesced
+
+
+def test_recover_genesis_resets_prior_epoch_state(tmp_path):
+    """A resumed controller writes a fresh genesis — replay must treat
+    it as a full reset so every epoch's journal is self-contained."""
+    j = Journal(str(tmp_path))
+    j.record_genesis(_pmap({"p0": {"primary": ["a"]}}), ["a"], ["a"], [],
+                     {}, {})
+    j.close()
+    state = recover(str(tmp_path))
+    j2 = state.journal
+    j2.record_genesis(_pmap({"p0": {"primary": ["b"]}}), ["b"], [], [],
+                      {}, {})
+    j2.close()
+    state2 = recover(str(tmp_path))
+    t0 = state2.tenants[None]
+    assert t0.nodes == ["b"]
+    assert t0.removing == set()
+    assert t0.pmap["p0"].nodes_by_state == {"primary": ["b"]}
+
+
+def test_recover_groups_tenant_tagged_records(tmp_path):
+    j = Journal(str(tmp_path))
+    va, vb = j.for_tenant("ta"), j.for_tenant("tb")
+    va.record_genesis(_pmap({"p0": {"primary": ["a"]}}), ["a"], [], [],
+                      {}, {})
+    vb.record_genesis(_pmap({"q0": {"primary": ["b"]}}), ["b"], [], [],
+                      {}, {})
+    j.append("fleet", {"event": "add_tenant", "tenant": "ta"})
+    j.close()
+    state = recover(str(tmp_path))
+    assert sorted(k for k in state.tenants if k is not None) == \
+        ["ta", "tb"]
+    assert sorted(state.tenants["ta"].pmap) == ["p0"]
+    assert sorted(state.tenants["tb"].pmap) == ["q0"]
+
+
+def test_recover_counts_and_successor_seq(tmp_path):
+    rec = Recorder()
+    with use_recorder(rec):
+        j = Journal(str(tmp_path))
+        for i in range(4):
+            j.append("delta", {"i": i})
+        j.close()
+        state = recover(str(tmp_path))
+    assert state.records_replayed == 4
+    # Successor seq continues after the replayed stream (fence record
+    # consumed next_seq=5).
+    assert state.next_seq == 6
+    assert rec.counters["durability.recoveries"] == 1
+    assert rec.counters["durability.replayed_records"] == 4
