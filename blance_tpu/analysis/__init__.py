@@ -21,6 +21,11 @@ blance_tpu's own static layer, run as the ``static`` CI tier:
   plane's declared shared state: read-modify-writes spanning an
   ``await``, stale guard flags, multi-task mutation without a
   serialization point (RACE0xx).
+- :mod:`.determinism` — taint-style replay-contract lint over code
+  reachable from the declared ``REPLAY_ROOTS``: wall-clock reads
+  outside ``CLOCK_SEAMS``, unseeded randomness, set-order flow into
+  ``SERIALIZED_SINKS``, unsorted ``json.dumps``, hash/id ordering,
+  undeclared env knobs (DET00x).
 - :mod:`.schedule` — the dynamic companion: deterministic schedule
   exploration (``python -m blance_tpu.analysis.schedule``) replaying
   orchestrator scenarios under seeded and bounded-exhaustive
@@ -118,27 +123,33 @@ def _iter_py_files(paths: Iterable[str]) -> list[str]:
 
 
 def run_lints(
-        paths: Optional[list[str]] = None) -> tuple[list[Finding], int]:
-    """Run the two AST passes over ``paths`` (default: the package).
+        paths: Optional[list[str]] = None,
+        determinism_only: bool = False) -> tuple[list[Finding], int]:
+    """Run the AST passes over ``paths`` (default: the package).
 
     Returns (findings, checked_file_count).  Pure host work — safe to
-    call from anywhere (no jax import).
+    call from anywhere (no jax import).  ``determinism_only`` is the
+    ``--determinism`` CLI mode: just the replay-contract pass.
     """
     from .asyncio_lint import lint_file as asyncio_lint_file
+    from .determinism import DeterminismPass
     from .jit_purity import JitPurityPass
     from .race_lint import lint_file as race_lint_file
 
     files = _iter_py_files(paths or [PACKAGE_ROOT])
     findings: list[Finding] = []
-    # jit purity needs the whole module set up front (cross-module call
-    # resolution); the asyncio and race lints are per-file (the race
-    # lint's shared-state model keys on class names, so it is inert
-    # outside the control plane by construction).
-    jit_pass = JitPurityPass(files, repo_root=REPO_ROOT)
-    findings.extend(jit_pass.run())
-    for f in files:
-        findings.extend(asyncio_lint_file(f, repo_root=REPO_ROOT))
-        findings.extend(race_lint_file(f, repo_root=REPO_ROOT))
+    # jit purity and determinism need the whole module set up front
+    # (cross-module call resolution); the asyncio and race lints are
+    # per-file (the race lint's shared-state model keys on class names,
+    # so it is inert outside the control plane by construction).
+    if not determinism_only:
+        jit_pass = JitPurityPass(files, repo_root=REPO_ROOT)
+        findings.extend(jit_pass.run())
+    findings.extend(DeterminismPass(files, repo_root=REPO_ROOT).run())
+    if not determinism_only:
+        for f in files:
+            findings.extend(asyncio_lint_file(f, repo_root=REPO_ROOT))
+            findings.extend(race_lint_file(f, repo_root=REPO_ROOT))
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings, len(files)
 
@@ -148,13 +159,14 @@ def run_all(
     baseline_path: Optional[str] = None,
     shape_audit: bool = True,
     retrace: bool = False,
+    determinism_only: bool = False,
 ) -> AnalysisResult:
     """Lints + (optionally) the eval_shape audit and the retrace-budget
     check, folded through the baseline.  The CLI and the CI gate both
     call this."""
     from .baseline import Baseline
 
-    findings, nfiles = run_lints(paths)
+    findings, nfiles = run_lints(paths, determinism_only=determinism_only)
     shape_entries = 0
     retrace_entries = 0
     errors: list[str] = []

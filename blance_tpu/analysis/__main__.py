@@ -37,6 +37,10 @@ def main(argv=None) -> int:
                     help="run the device-side retrace-budget check "
                          "(analysis/retrace.py) without the rest of "
                          "the --ci strictness")
+    ap.add_argument("--determinism", action="store_true",
+                    help="run ONLY the replay-determinism pass "
+                         "(analysis/determinism.py), still folded "
+                         "through the baseline")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="allowlist file (default: "
                          "blance_tpu/analysis/baseline.toml)")
@@ -46,8 +50,8 @@ def main(argv=None) -> int:
                     help="machine-readable output (one JSON object)")
     args = ap.parse_args(argv)
 
-    shape = args.ci or args.shape_audit
-    retrace = args.ci or args.retrace
+    shape = (args.ci or args.shape_audit) and not args.determinism
+    retrace = (args.ci or args.retrace) and not args.determinism
     if shape or retrace:
         # The sharded contracts want a multi-device mesh; force 8 virtual
         # CPU devices BEFORE jax first imports (same trick as
@@ -66,7 +70,14 @@ def main(argv=None) -> int:
         baseline_path=("/dev/null" if args.no_baseline else args.baseline),
         shape_audit=shape,
         retrace=retrace,
+        determinism_only=args.determinism,
     )
+
+    if args.determinism:
+        # Only the determinism pass ran: JIT/ASY/RACE pins are unused by
+        # construction, not stale.
+        result.unused_baseline = [
+            e for e in result.unused_baseline if e.rule.startswith("DET")]
 
     # Stale pins are warnings in the editor loop but HARD ERRORS under
     # --ci: a fixed finding must delete its suppression in the same
@@ -87,7 +98,7 @@ def main(argv=None) -> int:
             "retrace_entries": result.retrace_entries,
             "errors": result.errors,
             "pass": not failed,
-        }, indent=2))
+        }, indent=2, sort_keys=True))
     else:
         for f in result.new:
             print(f.render())

@@ -41,11 +41,10 @@ prove).
 from __future__ import annotations
 
 import ast
-import os
-from dataclasses import dataclass, field
 from typing import Optional
 
 from . import Finding
+from ._astutil import FuncInfo, ModuleIndex, ModuleInfo
 from ._astutil import dotted as _dotted
 
 __all__ = ["JitPurityPass"]
@@ -78,47 +77,6 @@ _COERCIONS = {"float", "int", "bool"}
 _TRACE_WRAPPER_SUFFIXES = ("shard_map",)
 
 
-@dataclass
-class FuncInfo:
-    module: str  # dotted module name
-    qualname: str  # "fn" or "Class.method"
-    node: ast.AST  # FunctionDef | AsyncFunctionDef
-    path: str  # repo-relative file path
-    params: list[str] = field(default_factory=list)
-    # Params with literal defaults: when such a function becomes a trace
-    # root through shard_map/partial wrapping (no static_argnames to
-    # consult), branching on them is almost always the benign
-    # Python-default pattern — exempt from JIT002/JIT003.
-    defaulted: set[str] = field(default_factory=set)
-    is_root: bool = False
-    statics: set[str] = field(default_factory=set)  # declared static argnames
-
-    @property
-    def fq(self) -> str:
-        return f"{self.module}.{self.qualname}"
-
-
-@dataclass
-class ModuleInfo:
-    name: str  # dotted
-    path: str  # repo-relative
-    tree: ast.Module
-    is_pkg: bool = False  # an __init__.py (relative imports resolve
-    # against the package itself, not its parent)
-    imports: dict[str, str] = field(default_factory=dict)
-    functions: dict[str, "FuncInfo"] = field(default_factory=dict)
-    constants: dict[str, object] = field(default_factory=dict)
-
-
-def _module_name(path: str, repo_root: str) -> str:
-    rel = os.path.relpath(os.path.abspath(path), repo_root)
-    rel = rel[:-3] if rel.endswith(".py") else rel
-    parts = rel.replace(os.sep, "/").split("/")
-    if parts[-1] == "__init__":
-        parts = parts[:-1]
-    return ".".join(parts)
-
-
 def _literal_strings(node: ast.AST, constants: dict[str, object]
                      ) -> Optional[list[str]]:
     """Extract a tuple/list of string literals, following one level of
@@ -147,143 +105,13 @@ class JitPurityPass:
 
     def __init__(self, files: list[str], repo_root: str) -> None:
         self.repo_root = repo_root
-        self.modules: dict[str, ModuleInfo] = {}
+        self.index = ModuleIndex(files, repo_root)
+        self.modules: dict[str, ModuleInfo] = self.index.modules
         self.findings: list[Finding] = []
-        for path in files:
-            try:
-                with open(path) as f:
-                    src = f.read()
-                tree = ast.parse(src, filename=path)
-            except SyntaxError as e:
-                rel = os.path.relpath(os.path.abspath(path), repo_root)
-                self.findings.append(Finding(
-                    rule="JIT000", path=rel.replace(os.sep, "/"),
-                    line=e.lineno or 0, symbol="",
-                    message=f"file does not parse: {e.msg}"))
-                continue
-            name = _module_name(path, repo_root)
-            rel = os.path.relpath(
-                os.path.abspath(path), repo_root).replace(os.sep, "/")
-            mi = ModuleInfo(name=name, path=rel, tree=tree,
-                            is_pkg=rel.endswith("__init__.py"))
-            self._index_module(mi)
-            self.modules[name] = mi
-
-    # -- indexing -----------------------------------------------------------
-
-    def _index_module(self, mi: ModuleInfo) -> None:
-        for node in mi.tree.body:
-            self._index_stmt(mi, node, prefix="")
-
-    def _index_stmt(self, mi: ModuleInfo, node: ast.stmt,
-                    prefix: str) -> None:
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                mi.imports[alias.asname or alias.name.split(".")[0]] = \
-                    alias.name if alias.asname else \
-                    alias.name.split(".")[0]
-                if alias.asname:
-                    mi.imports[alias.asname] = alias.name
-        elif isinstance(node, ast.ImportFrom):
-            base = self._resolve_from(mi, node)
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                mi.imports[alias.asname or alias.name] = \
-                    f"{base}.{alias.name}" if base else alias.name
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            qn = f"{prefix}{node.name}"
-            args = node.args
-            params = ([a.arg for a in args.posonlyargs]
-                      + [a.arg for a in args.args]
-                      + [a.arg for a in args.kwonlyargs])
-            if args.vararg:
-                params.append(args.vararg.arg)
-            if args.kwarg:
-                params.append(args.kwarg.arg)
-            defaulted: set[str] = set()
-            pos = [a.arg for a in args.posonlyargs] + \
-                [a.arg for a in args.args]
-            for name_, default in zip(pos[len(pos) - len(args.defaults):],
-                                      args.defaults):
-                if isinstance(default, ast.Constant):
-                    defaulted.add(name_)
-            for a, default in zip(args.kwonlyargs, args.kw_defaults):
-                if isinstance(default, ast.Constant):
-                    defaulted.add(a.arg)
-            mi.functions[qn] = FuncInfo(
-                module=mi.name, qualname=qn, node=node, path=mi.path,
-                params=params, defaulted=defaulted)
-        elif isinstance(node, ast.ClassDef):
-            for sub in node.body:
-                self._index_stmt(mi, sub, prefix=f"{node.name}.")
-        elif isinstance(node, ast.Assign) and not prefix:
-            # Module-level literal constants (for static_argnames=NAME).
-            if len(node.targets) == 1 and \
-                    isinstance(node.targets[0], ast.Name):
-                try:
-                    mi.constants[node.targets[0].id] = \
-                        ast.literal_eval(node.value)
-                except (ValueError, SyntaxError):
-                    pass
-
-    def _resolve_from(self, mi: ModuleInfo, node: ast.ImportFrom) -> str:
-        if not node.level:
-            return node.module or ""
-        parts = mi.name.split(".")
-        # level=1 is the CURRENT package: for a module that is its
-        # parent (drop the module's own name); for an __init__.py the
-        # module name IS the package.  Each extra level pops one more.
-        base = parts if mi.is_pkg else parts[:-1]
-        extra = node.level - 1
-        base = base[:len(base) - extra] if extra else base
-        if node.module:
-            base = base + node.module.split(".")
-        return ".".join(base)
-
-    # -- symbol resolution --------------------------------------------------
-
-    def _resolve(self, mi: ModuleInfo, dotted: str) -> str:
-        """Map a dotted local reference to its fully-qualified spelling."""
-        head, _, rest = dotted.partition(".")
-        fq_head = mi.imports.get(head, head)
-        return f"{fq_head}.{rest}" if rest else fq_head
-
-    def _lookup_function(self, mi: ModuleInfo, dotted: str):
-        """Resolve a reference to a FuncInfo in the analyzed set."""
-        # Same-module bare name (incl. Class.method chains).
-        if dotted in mi.functions:
-            return mi.functions[dotted]
-        return self._lookup_fq(self._resolve(mi, dotted))
-
-    def _lookup_fq(self, fq: str, depth: int = 0):
-        """Find a FuncInfo by fully-qualified name, chasing package
-        re-exports: ``pkg.helper`` where pkg/__init__.py does ``from
-        .impl import helper`` resolves to ``pkg.impl.helper`` — the
-        idiom this codebase uses for its public surfaces, which the
-        jit-purity call graph must see through (depth-bounded: a
-        re-export cycle must not hang the lint)."""
-        if depth > 8:
-            return None
-        # fq = "pkg.module.func" or "pkg.module.Class.func".
-        parts = fq.split(".")
-        for cut in range(len(parts) - 1, 0, -1):
-            mod = ".".join(parts[:cut])
-            rest = ".".join(parts[cut:])
-            target = self.modules.get(mod)
-            if target is None:
-                continue
-            if rest in target.functions:
-                return target.functions[rest]
-            # Re-export chase: the symbol's head may be imported into
-            # ``mod`` from somewhere else in the analyzed set.
-            head, _, tail = rest.partition(".")
-            if head in target.imports:
-                re_fq = target.imports[head] + ("." + tail if tail else "")
-                found = self._lookup_fq(re_fq, depth + 1)
-                if found is not None:
-                    return found
-        return None
+        for rel, line, msg in self.index.parse_errors:
+            self.findings.append(Finding(
+                rule="JIT000", path=rel, line=line, symbol="",
+                message=f"file does not parse: {msg}"))
 
     # -- root discovery -----------------------------------------------------
 
@@ -291,7 +119,7 @@ class JitPurityPass:
         dotted = _dotted(node)
         if dotted is None:
             return False
-        fq = self._resolve(mi, dotted)
+        fq = self.index.resolve(mi, dotted)
         return fq in ("jax.jit", "jax.pjit", "jax.jit.jit") or \
             fq.endswith(".jit") and fq.startswith("jax")
 
@@ -299,7 +127,7 @@ class JitPurityPass:
         dotted = _dotted(node)
         if dotted is None:
             return False
-        fq = self._resolve(mi, dotted)
+        fq = self.index.resolve(mi, dotted)
         # lstrip("_"): version-portability shims are conventionally the
         # wrapped name with a leading underscore (parallel/sharded.py's
         # ``_shard_map``).
@@ -312,7 +140,7 @@ class JitPurityPass:
         target = None
         if isinstance(func_ref, ast.Call):
             # partial(f, ...) inline
-            inner = self._partial_target(mi, func_ref)
+            inner = self.index.partial_target(mi, func_ref)
             if inner is not None:
                 target = inner
         else:
@@ -320,24 +148,10 @@ class JitPurityPass:
             if dotted is not None:
                 if dotted in aliases:
                     dotted = aliases[dotted]
-                target = self._lookup_function(mi, dotted)
+                target = self.index.lookup_function(mi, dotted)
         if target is not None:
             target.is_root = True
             target.statics |= statics
-
-    def _partial_target(self, mi: ModuleInfo, call: ast.Call):
-        """partial(f, ...) -> FuncInfo for f (one level)."""
-        dotted = _dotted(call.func)
-        if dotted is None:
-            return None
-        if self._resolve(mi, dotted) != "functools.partial":
-            return None
-        if not call.args:
-            return None
-        inner = _dotted(call.args[0])
-        if inner is None:
-            return None
-        return self._lookup_function(mi, inner)
 
     def _jit_statics(self, mi: ModuleInfo, call: ast.Call,
                      wrapped) -> set[str]:
@@ -385,7 +199,7 @@ class JitPurityPass:
                         dotted = _dotted(node.args[0])
                         if dotted is not None:
                             dotted = aliases.get(dotted, dotted)
-                            wrapped = self._lookup_function(mi, dotted)
+                            wrapped = self.index.lookup_function(mi, dotted)
                     statics = self._jit_statics(mi, node, wrapped)
                     if wrapped is not None:
                         wrapped.is_root = True
@@ -395,7 +209,7 @@ class JitPurityPass:
                     inner = node.func
                     if isinstance(inner, ast.Call) and inner.args and \
                             self._is_jit_ref(mi, inner.args[0]) and \
-                            self._resolve(
+                            self.index.resolve(
                                 mi, _dotted(inner.func) or "") == \
                             "functools.partial":
                         wrapped = None
@@ -403,7 +217,7 @@ class JitPurityPass:
                             dotted = _dotted(node.args[0])
                             if dotted is not None:
                                 dotted = aliases.get(dotted, dotted)
-                                wrapped = self._lookup_function(mi, dotted)
+                                wrapped = self.index.lookup_function(mi, dotted)
                         statics = self._jit_statics(mi, inner, wrapped)
                         if wrapped is not None:
                             wrapped.is_root = True
@@ -415,7 +229,7 @@ class JitPurityPass:
                 # partial(_shard_map, body, ...): treat as a wrapper call
                 dotted = _dotted(node.func)
                 if dotted is not None and \
-                        self._resolve(mi, dotted) == "functools.partial" \
+                        self.index.resolve(mi, dotted) == "functools.partial" \
                         and node.args and \
                         self._is_trace_wrapper_ref(mi, node.args[0]):
                     for arg in list(node.args[1:]) + \
@@ -432,7 +246,7 @@ class JitPurityPass:
                 fn.is_root = True
                 fn.statics |= self._jit_statics(mi, dec, fn)
             elif dec.args and self._is_jit_ref(mi, dec.args[0]) and \
-                    self._resolve(mi, _dotted(dec.func) or "") == \
+                    self.index.resolve(mi, _dotted(dec.func) or "") == \
                     "functools.partial":  # @partial(jax.jit, ...)
                 fn.is_root = True
                 fn.statics |= self._jit_statics(mi, dec, fn)
@@ -450,7 +264,7 @@ class JitPurityPass:
                 continue
             val = node.value
             if isinstance(val, ast.Call):
-                info = self._partial_target(mi, val)
+                info = self.index.partial_target(mi, val)
                 if info is not None and info.module == mi.name:
                     aliases[tgt.id] = info.qualname
                 elif info is not None:
@@ -458,47 +272,16 @@ class JitPurityPass:
             else:
                 dotted = _dotted(val)
                 if dotted is not None and \
-                        self._lookup_function(mi, dotted) is not None:
+                        self.index.lookup_function(mi, dotted) is not None:
                     aliases[tgt.id] = dotted
         return aliases
 
     # -- reachability -------------------------------------------------------
 
-    def _reachable(self) -> list["FuncInfo"]:
+    def _reachable(self) -> list[FuncInfo]:
         roots = [fn for mi in self.modules.values()
                  for fn in mi.functions.values() if fn.is_root]
-        seen = {fn.fq for fn in roots}
-        queue = list(roots)
-        while queue:
-            fn = queue.pop()
-            mi = self.modules[fn.module]
-            for node in ast.walk(fn.node):
-                dotted = None
-                if isinstance(node, ast.Call):
-                    dotted = _dotted(node.func)
-                    inner = self._partial_target(mi, node) \
-                        if dotted and self._resolve(mi, dotted) == \
-                        "functools.partial" else None
-                    if inner is not None and inner.fq not in seen:
-                        seen.add(inner.fq)
-                        queue.append(inner)
-                elif isinstance(node, ast.Name) and \
-                        isinstance(node.ctx, ast.Load):
-                    dotted = node.id
-                if dotted is None:
-                    continue
-                callee = self._lookup_function(mi, dotted)
-                if callee is not None and callee.fq not in seen:
-                    seen.add(callee.fq)
-                    queue.append(callee)
-        return [self._by_fq(fq) for fq in sorted(seen)]
-
-    def _by_fq(self, fq: str):
-        for mi in self.modules.values():
-            for fn in mi.functions.values():
-                if fn.fq == fq:
-                    return fn
-        raise KeyError(fq)
+        return self.index.reachable(roots)
 
     # -- the lint -----------------------------------------------------------
 
@@ -562,7 +345,7 @@ class JitPurityPass:
 
             dotted = _dotted(node.func)
             if dotted is not None:
-                fq = self._resolve(mi, dotted)
+                fq = self.index.resolve(mi, dotted)
                 # JIT001: host nondeterminism
                 for prefix, why in _NONDET_PREFIXES.items():
                     hit = fq == prefix or (prefix.endswith(".") and

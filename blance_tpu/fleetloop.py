@@ -58,7 +58,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import time
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
 if TYPE_CHECKING:  # pragma: no cover - type-only (import cycle)
@@ -78,6 +77,7 @@ from .plan.fleet import TenantProblem
 from .plan.resident import EncodedState, build_encoded_state
 from .plan.service import PlanService
 from .rebalance import ClusterDelta, RebalanceController
+from .utils.hostclock import perf_now
 
 __all__ = ["FleetController", "ServicePlanner", "TenantLoop"]
 
@@ -142,7 +142,7 @@ class ServicePlanner(CyclePlanner):
                 f"dense batch solver, which does not support "
                 f"node_score_booster/node_scorer/node_sorter hooks — "
                 f"run this tenant on a local planner instead")
-        t0 = time.perf_counter()
+        t0 = perf_now()
         problem, st = self._encode(current, nodes, removes, model, opts)
         fp = (frozenset(removes), tuple(problem.partitions),
               tuple(problem.prev.shape), problem.N,
@@ -150,9 +150,9 @@ class ServicePlanner(CyclePlanner):
               problem.node_weights.tobytes())
         dirty = self._dirty_for(problem, fp)
         tenant = TenantProblem.from_dense(self.key, problem, dirty=dirty)
-        self.host_phase["encode"] += time.perf_counter() - t0
+        self.host_phase["encode"] += perf_now() - t0
         result = await self._service.submit(tenant)
-        t1 = time.perf_counter()
+        t1 = perf_now()
         if st is None:
             next_map, warnings = decode_assignment(
                 problem, result.assign, current, removes)
@@ -167,7 +167,7 @@ class ServicePlanner(CyclePlanner):
                 self._rec.observe("fleet.decode_dirty_rows",
                                   float(nrows))
         self._last = fp
-        self.host_phase["decode"] += time.perf_counter() - t1
+        self.host_phase["decode"] += perf_now() - t1
         return next_map, warnings
 
     # -- the encode-residency layer (plan/resident.py) ---------------------

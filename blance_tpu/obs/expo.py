@@ -660,7 +660,7 @@ class MetricsServer:
                                     if self._started_at is not None
                                     else None)}
             return "503 Service Unavailable", \
-                (json.dumps(payload) + "\n").encode()
+                (json.dumps(payload, sort_keys=True) + "\n").encode()
         payload = {
             "status": "ok",
             "uptime_s": (now - self._started_at
@@ -668,7 +668,8 @@ class MetricsServer:
             "snapshot_age_s": now - self._cached_at,
             "snapshots": self._snapshots,
         }
-        return "200 OK", (json.dumps(payload) + "\n").encode()
+        return "200 OK", \
+            (json.dumps(payload, sort_keys=True) + "\n").encode()
 
     # -- server lifecycle ----------------------------------------------------
 

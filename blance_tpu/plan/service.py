@@ -50,7 +50,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import time
 import warnings
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -58,6 +57,7 @@ from functools import partial
 from typing import TYPE_CHECKING, Optional
 
 from ..obs import get_recorder
+from ..utils.hostclock import perf_now
 from ..obs.tracectx import RequestTimeline, TraceContext, TraceIdSource
 from .carry import CarryCache
 from .fleet import FleetResult, TenantProblem, solve_fleet, validate_tenant
@@ -412,12 +412,12 @@ class PlanService:
         (batch closed → solver started) from its ``device`` segment."""
         rec = self._rec
         t_start = rec.now()
-        w0 = time.perf_counter()
+        w0 = perf_now()
         results = solve_fleet(
             problems, mesh=self.mesh,
             max_iterations=self.max_iterations, recorder=rec,
             trace_ids=trace_ids, batch_floor=self.batch_floor)
-        self.host_solve_s += time.perf_counter() - w0
+        self.host_solve_s += perf_now() - w0
         return t_start, rec.now(), results
 
     async def _run(self) -> None:

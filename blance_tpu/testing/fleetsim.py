@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
@@ -51,6 +50,7 @@ from ..obs.expo import render_prometheus
 from ..obs.recorder import percentile
 from ..obs.slo import FleetSloSummary, SloSummary
 from ..rebalance import ClusterDelta
+from ..utils.hostclock import perf_now
 from .scenarios import FleetScenario, FleetTenant
 from .sched import DeterministicLoop, FifoPolicy
 
@@ -377,10 +377,10 @@ def run_fleet_scenario(scn: FleetScenario,
     host wall-clock and the ``fleet.encode_*`` accounting differ."""
     loop = DeterministicLoop(FifoPolicy(), max_steps=scn.max_steps)
     rec = Recorder(clock=loop.time)
-    t0 = time.perf_counter()
+    t0 = perf_now()
     with use_recorder(rec):
         report = loop.run_until_complete(
             _fleet_main(scn, loop, rec, coalesce, encode_residency))
-    report.wall_s = time.perf_counter() - t0
+    report.wall_s = perf_now() - t0
     report.steps = loop.steps
     return report

@@ -38,7 +38,6 @@ seed produces byte-identical log text, pinned by committed traces under
 from __future__ import annotations
 
 import json
-import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
@@ -50,6 +49,7 @@ from ..orchestrate.faults import FaultPlan
 from ..orchestrate.orchestrator import OrchestratorOptions
 from ..plan.api import plan_next_map
 from ..rebalance import RebalanceController, count_moves
+from ..utils.hostclock import perf_now
 from .scenarios import SimScenario, initial_map, scenario_model
 from .sched import DeterministicLoop, FifoPolicy
 
@@ -321,10 +321,10 @@ def run_scenario(scn: SimScenario) -> SimReport:
     only host-dependent fields."""
     loop = DeterministicLoop(FifoPolicy(), max_steps=scn.max_steps)
     rec = Recorder(clock=loop.time)
-    t0 = time.perf_counter()
+    t0 = perf_now()
     with use_recorder(rec):
         report = loop.run_until_complete(_sim_main(scn, loop, rec))
-    report.wall_s = time.perf_counter() - t0
+    report.wall_s = perf_now() - t0
     report.steps = loop.steps
     return report
 

@@ -17,11 +17,11 @@ framework exposes:
 from __future__ import annotations
 
 import contextlib
-import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from ..obs import get_recorder
+from .hostclock import perf_now
 
 __all__ = ["PhaseTimer", "device_profile"]
 
@@ -40,12 +40,12 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
+        start = perf_now()
         try:
             with get_recorder().span(name):
                 yield
         finally:
-            self._accumulate(name, time.perf_counter() - start)
+            self._accumulate(name, perf_now() - start)
 
     def _accumulate(self, name: str, elapsed: float) -> None:
         """Fold one elapsed interval into the report totals — the piece of

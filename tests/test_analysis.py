@@ -840,3 +840,299 @@ def test_shape_audit_catches_drift(monkeypatch):
     monkeypatch.setattr(sa, "CONTRACTS", (broken,))
     findings, _ = sa.run_shape_audit()
     assert any(f.rule == "SHP001" for f in findings)
+
+
+# -- determinism lint: each rule trips, and its clean twin does not ----------
+
+
+def _det_findings(tmp_path, source, name="mod.py", *, roots=None,
+                  clock_seams=None, serialized_sinks=None,
+                  config_knobs=None):
+    from blance_tpu.analysis.determinism import DeterminismPass
+
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return DeterminismPass(
+        [str(f)], repo_root=str(tmp_path),
+        replay_roots={"mod": "fixture"} if roots is None else roots,
+        clock_seams=clock_seams or {},
+        serialized_sinks=serialized_sinks,
+        config_knobs=config_knobs or {},
+    ).run()
+
+
+def test_det001_wall_clock_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import time
+
+        def f():
+            return time.monotonic()
+    """)
+    assert _rules(fs) == ["DET001"]
+    assert fs[0].symbol == "f"
+
+
+def test_det001_raw_loop_time_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def f(loop):
+            return loop.time() + 1.0
+    """)
+    assert _rules(fs) == ["DET001"]
+
+
+def test_det001_clean_inside_declared_seam(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import time
+
+        def f():
+            return time.perf_counter()
+    """, clock_seams={"mod.f": "the declared boundary"})
+    assert fs == []
+
+
+def test_det001_clean_injected_clock_default(tmp_path):
+    # A default-parameter REFERENCE to the clock is the injectable-seam
+    # idiom (Recorder, HealthTracker) — only CALLS trip the rule.
+    fs = _det_findings(tmp_path, """
+        import time
+
+        def f(clock=time.monotonic):
+            return clock()
+    """)
+    assert fs == []
+
+
+def test_det002_unseeded_randomness_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import random
+        import uuid
+
+        def f():
+            return random.random(), uuid.uuid4(), random.Random()
+    """)
+    assert _rules(fs) == ["DET002"]
+    assert len(fs) == 3
+
+
+def test_det002_numpy_global_prng_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import numpy as np
+
+        def f(n):
+            return np.random.rand(n)
+    """)
+    assert _rules(fs) == ["DET002"]
+
+
+def test_det002_clean_seeded_random(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import random
+
+        def f(seed):
+            rng = random.Random(seed)
+            return rng.random()
+    """)
+    assert fs == []
+
+
+def test_det003_set_into_sink_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def canonical_log_text(events):
+            return str(events)
+
+        def f(xs):
+            pending = set(xs)
+            return canonical_log_text(pending)
+    """)
+    assert "DET003" in _rules(fs)
+
+
+def test_det003_propagates_through_list(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def canonical_log_text(events):
+            return str(events)
+
+        def f(xs):
+            pending = set(xs)
+            items = list(pending)
+            return canonical_log_text(items)
+    """)
+    assert "DET003" in _rules(fs)
+
+
+def test_det003_clean_with_sorted_on_path(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def canonical_log_text(events):
+            return str(events)
+
+        def f(xs):
+            pending = set(xs)
+            return canonical_log_text(sorted(pending))
+    """)
+    assert fs == []
+
+
+def test_det004_json_dumps_without_sort_keys_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import json
+
+        def f(d):
+            return json.dumps(d)
+    """)
+    assert _rules(fs) == ["DET004"]
+    assert fs[0].symbol == "f"
+
+
+def test_det004_clean_sort_keys_and_passthrough(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import json
+
+        def f(d):
+            return json.dumps(d, sort_keys=True)
+
+        def g(d, sort_keys):
+            return json.dumps(d, sort_keys=sort_keys)
+    """)
+    assert fs == []
+
+
+def test_det005_hash_ordering_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def f(xs):
+            xs.sort(key=lambda x: hash(x))
+            return sorted(xs, key=lambda x: (id(x), x))
+    """)
+    assert _rules(fs) == ["DET005"]
+    assert len(fs) == 2
+
+
+def test_det005_clean_field_key_and_identity_id(tmp_path):
+    fs = _det_findings(tmp_path, """
+        def f(xs, h):
+            keep = id(xs)  # identity use outside ordering is fine
+            h[keep] = True
+            return sorted(xs, key=lambda x: x.name)
+    """)
+    assert fs == []
+
+
+def test_det006_env_read_trips(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import os
+
+        def f():
+            return os.environ.get("KNOB", "1"), os.environ["OTHER"]
+
+        def g():
+            return os.getenv("THIRD")
+    """)
+    assert _rules(fs) == ["DET006"]
+    assert len(fs) == 3
+
+
+def test_det006_clean_declared_knob(tmp_path):
+    fs = _det_findings(tmp_path, """
+        import os
+
+        def f():
+            return os.environ.get("KNOB", "1")
+    """, config_knobs={"mod.f": "KNOB: fixture"})
+    assert fs == []
+
+
+def test_det_rules_only_fire_on_replay_reachable_code(tmp_path):
+    # Same wall-clock call, but the module is not under any replay root
+    # and nothing reaches it: DET001 stays quiet (DET004 is the one
+    # package-wide rule).
+    fs = _det_findings(tmp_path, """
+        import time
+
+        def f():
+            return time.monotonic()
+    """, roots={"other_module": "not this one"})
+    assert fs == []
+
+
+def _resolve_fq(fq):
+    import importlib
+
+    parts = fq.split(".")
+    for cut in range(len(parts), 0, -1):
+        try:
+            obj = importlib.import_module(".".join(parts[:cut]))
+        except ImportError:
+            continue
+        try:
+            for attr in parts[cut:]:
+                obj = getattr(obj, attr)
+        except AttributeError:
+            return None
+        return obj
+    return None
+
+
+def test_determinism_tables_match_reality():
+    """Every REPLAY_ROOTS / CLOCK_SEAMS / CONFIG_KNOBS entry must name a
+    real module/class/function — a renamed symbol would silently blind
+    the lint (same guard pattern as the race lint's SHARED_STATE)."""
+    from blance_tpu.analysis.determinism import (
+        CLOCK_SEAMS,
+        CONFIG_KNOBS,
+        REPLAY_ROOTS,
+    )
+
+    for table_name, table in [("REPLAY_ROOTS", REPLAY_ROOTS),
+                              ("CLOCK_SEAMS", CLOCK_SEAMS),
+                              ("CONFIG_KNOBS", CONFIG_KNOBS)]:
+        for fq, reason in table.items():
+            assert reason.strip(), f"{table_name}[{fq!r}] has no reason"
+            assert _resolve_fq(fq) is not None, (
+                f"{table_name} entry {fq!r} does not resolve to a real "
+                f"symbol — update the table")
+
+
+def test_determinism_sinks_match_reality():
+    """Each SERIALIZED_SINKS suffix must have a real representative
+    symbol, so a renamed renderer can't silently un-cover its artifact."""
+    from blance_tpu.analysis.determinism import SERIALIZED_SINKS
+
+    representatives = {
+        "journal.append": "blance_tpu.durability.journal.Journal.append",
+        "canonical_log_text":
+            "blance_tpu.testing.simulate.canonical_log_text",
+        "canonical_fleet_log_text":
+            "blance_tpu.testing.fleetsim.canonical_fleet_log_text",
+        "crash_log_text": "blance_tpu.testing.crashsim.crash_log_text",
+        "render_prometheus": "blance_tpu.obs.expo.render_prometheus",
+        "atomic_write_json": "blance_tpu.utils.atomicio.atomic_write_json",
+        "atomic_write_text": "blance_tpu.utils.atomicio.atomic_write_text",
+    }
+    assert set(representatives) == set(SERIALIZED_SINKS), \
+        "new sink entries need a representative symbol here"
+    for sink, fq in representatives.items():
+        assert _resolve_fq(fq) is not None, (
+            f"SERIALIZED_SINKS representative for {sink!r} ({fq}) does "
+            f"not resolve — update the table or this map")
+
+
+def test_determinism_real_package_is_clean():
+    """The real package carries ZERO determinism findings, baselined or
+    not — the triage (hostclock seam, sort_keys fixes, declared knobs)
+    left nothing to allowlist."""
+    from blance_tpu.analysis import PACKAGE_ROOT, REPO_ROOT, _iter_py_files
+    from blance_tpu.analysis.determinism import DeterminismPass
+
+    findings = DeterminismPass(
+        _iter_py_files([PACKAGE_ROOT]), REPO_ROOT).run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_determinism_only_mode(capsys):
+    from blance_tpu.analysis.__main__ import main
+
+    rc = main(["--determinism"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 new finding(s)" in out
+    # JIT/ASY/RACE baseline pins must NOT be reported stale in this mode.
+    assert "stale baseline entry" not in out

@@ -1,0 +1,95 @@
+"""Hash-seed replay smoke: the dynamic twin of DET005.
+
+The static rule (analysis/determinism.py DET005) bans ordering keyed on
+``hash()``/``id()``; this suite proves the property end-to-end by
+regenerating every committed replay artifact in TWO fresh interpreters
+with *different* ``PYTHONHASHSEED`` values and asserting byte-identity —
+against each other AND against the committed files.  Any str-hash
+iteration order that leaks into an event log, a fleet log, a crash
+journal or an explored schedule shows up here as a diff between seeds.
+
+``PYTHONHASHSEED`` only takes effect at interpreter start, so each run
+is a subprocess (slow-marked; CI runs this as its own sim-smoke step).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+TRACES = {
+    "sim_spot_preemption_s11": "tests/traces/sim_spot_preemption_s11.json",
+    "fleet_zone_outage_s5_t8": "tests/traces/fleet_zone_outage_s5_t8.json",
+    "crash_storm_s19": "tests/traces/crash_storm_s19.json",
+}
+
+# Regenerates every artifact in one interpreter and prints a JSON map of
+# name -> text (sorted keys: the driver obeys DET004 too).
+_DRIVER = r"""
+import json, sys, tempfile
+
+out = {}
+
+from blance_tpu.testing.scenarios import (
+    crash_storm, fleet_zone_outage, spot_preemption)
+from blance_tpu.testing.simulate import run_scenario
+out["sim_spot_preemption_s11"] = run_scenario(spot_preemption(11)).log_text()
+
+from blance_tpu.testing.fleetsim import run_fleet_scenario
+out["fleet_zone_outage_s5_t8"] = run_fleet_scenario(
+    fleet_zone_outage(seed=5, tenants=8)).log_text()
+
+from blance_tpu.testing.crashsim import run_crash_scenario
+cs = crash_storm(19)
+out["crash_storm_s19"] = run_crash_scenario(
+    cs.base, tempfile.mkdtemp(), crashes=cs.crashes,
+    snapshot_every=cs.snapshot_every,
+    rotate_records=cs.rotate_records).log_text()
+
+from blance_tpu.analysis.schedule import SCENARIOS
+from blance_tpu.testing.sched import load_trace, replay
+trace = load_trace(sys.argv[1])
+res = replay(SCENARIOS["pause_cycle_guard"].factory, trace, strict=False)
+out["pause_cycle_guard"] = json.dumps(
+    {"ok": res.ok, "signature": res.signature, "steps": res.steps,
+     "choices": res.choices, "candidate_counts": res.candidate_counts},
+    sort_keys=True)
+
+print(json.dumps(out, sort_keys=True))
+"""
+
+
+def _regenerate(hashseed: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PYTHONHASHSEED": hashseed,
+        "JAX_PLATFORMS": "cpu",
+        "BLANCE_WAL_FSYNC": "0",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER,
+         "tests/traces/pause_cycle_guard.json"],
+        capture_output=True, text=True, env=env, timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"driver failed under PYTHONHASHSEED={hashseed}:\n{proc.stderr}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_replays_are_hashseed_independent():
+    a = _regenerate("0")
+    b = _regenerate("1")
+    for name in sorted(set(a) | set(b)):
+        assert a[name] == b[name], (
+            f"{name}: artifact differs between PYTHONHASHSEED=0 and =1 "
+            f"— str-hash order is leaking into a replayed path")
+    # And both match the committed artifacts byte-for-byte.
+    for name, path in TRACES.items():
+        with open(path) as f:
+            committed = f.read()
+        assert a[name] == committed, (
+            f"{name}: regenerated artifact drifted from {path}")
+    assert json.loads(a["pause_cycle_guard"])["ok"] is True
