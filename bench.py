@@ -2138,6 +2138,22 @@ def main():
         }))
 
 
+def _bench_membudget():
+    """Measure every budgeted solver entry's AOT peak allocation
+    against HBM_BUDGETS (zero FLOPs — abstract operands end to end) and
+    log the table.  Returns the measured-vs-budget rows for
+    detail.membudget."""
+    from blance_tpu.analysis.membudget import measure_budget_table
+
+    rows = measure_budget_table(["smoke"])
+    for r in rows:
+        got = r.get("measured", r.get("error"))
+        log(f"[membudget] {r['entry']:<24} {r['class']:<6} "
+            f"peak={got} budget={r['budget']} "
+            f"{'OK' if r.get('ok') else 'OVER'}")
+    return rows
+
+
 def _run_perf_smoke():
     """The CI perf gate (bench.py --perf-smoke): delta-replan at smoke
     size on CPU; exit 1 when warm sweeps fail to beat cold sweeps or the
@@ -2265,6 +2281,20 @@ def _run_perf_smoke():
         durability_ok = False
     ok = ok and durability_ok
 
+    # Membudget gate (ISSUE 20): every solver entry's AOT peak bytes
+    # must sit under its declarative HBM ceiling
+    # (blance_tpu.analysis.membudget.HBM_BUDGETS) — the same table the
+    # --ci static tier enforces, re-measured here so the perf artifact
+    # embeds the measured-vs-budget evidence (detail.membudget) next to
+    # the numbers it explains.
+    try:
+        mb_rows = _bench_membudget()
+        mb_ok = bool(mb_rows) and all(r.get("ok") for r in mb_rows)
+    except Exception as e:  # any stage crash must fail THIS gate
+        mb_rows = [{"error": first_line(e)}]
+        mb_ok = False
+    ok = ok and mb_ok
+
     print(json.dumps({
         "metric": "delta-replan perf smoke (warm vs cold sweeps)",
         "value": res["warm_sweeps"],
@@ -2272,7 +2302,7 @@ def _run_perf_smoke():
         "vs_baseline": res["cold_sweeps"],
         "detail": {**res, "pipeline": pipe, "sparse": sparse,
                    "sched": sched, "fleet_loop": floop,
-                   "durability": durability},
+                   "durability": durability, "membudget": mb_rows},
         "pass": ok,
     }))
     if not ok:
@@ -2282,7 +2312,8 @@ def _run_perf_smoke():
             f"{'OK' if pipe_ok else f'FAILED: {pipe}'}; sparse "
             f"{'OK' if sparse_ok else f'FAILED: {sparse}'}; fleet_loop "
             f"{'OK' if floop_ok else f'FAILED: {floop}'}; durability "
-            f"{'OK' if durability_ok else f'FAILED: {durability}'}")
+            f"{'OK' if durability_ok else f'FAILED: {durability}'}; "
+            f"membudget {'OK' if mb_ok else f'FAILED: {mb_rows}'}")
         sys.exit(1)
 
 

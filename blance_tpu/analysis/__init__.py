@@ -26,6 +26,16 @@ blance_tpu's own static layer, run as the ``static`` CI tier:
   outside ``CLOCK_SEAMS``, unseeded randomness, set-order flow into
   ``SERIALIZED_SINKS``, unsorted ``json.dumps``, hash/id ordering,
   undeclared env knobs (DET00x).
+- :mod:`.donation` — use-after-donation liveness lint over every
+  ``jax.jit(..., donate_argnames/argnums=...)`` dispatch site: reads of
+  a donated operand after its dispatch (incl. aliases, attribute
+  roots, packed tuples, returns), pre-dispatch escapes into
+  longer-lived state, double dispatch without rebinding, post-dispatch
+  host snapshots (DON00x).
+- :mod:`.membudget` — the declarative per-entry HBM ceiling table
+  (``HBM_BUDGETS``), checked against AOT ``memory_analysis()`` peak
+  bytes at smoke shapes with zero FLOPs executed (MEM00x), so the
+  device-memory contract rides the same baseline/CI machinery.
 - :mod:`.schedule` — the dynamic companion: deterministic schedule
   exploration (``python -m blance_tpu.analysis.schedule``) replaying
   orchestrator scenarios under seeded and bounded-exhaustive
@@ -100,6 +110,7 @@ class AnalysisResult:
     checked_files: int = 0
     shape_entries: int = 0
     retrace_entries: int = 0
+    membudget_entries: int = 0
     # analyzer crashes (fatal)
     errors: list[str] = field(default_factory=list)
 
@@ -124,29 +135,36 @@ def _iter_py_files(paths: Iterable[str]) -> list[str]:
 
 def run_lints(
         paths: Optional[list[str]] = None,
-        determinism_only: bool = False) -> tuple[list[Finding], int]:
+        determinism_only: bool = False,
+        donation_only: bool = False) -> tuple[list[Finding], int]:
     """Run the AST passes over ``paths`` (default: the package).
 
     Returns (findings, checked_file_count).  Pure host work — safe to
-    call from anywhere (no jax import).  ``determinism_only`` is the
-    ``--determinism`` CLI mode: just the replay-contract pass.
+    call from anywhere (no jax import).  ``determinism_only`` /
+    ``donation_only`` are the ``--determinism`` / ``--donation`` CLI
+    modes: just that one pass.
     """
     from .asyncio_lint import lint_file as asyncio_lint_file
     from .determinism import DeterminismPass
+    from .donation import DonationPass
     from .jit_purity import JitPurityPass
     from .race_lint import lint_file as race_lint_file
 
     files = _iter_py_files(paths or [PACKAGE_ROOT])
     findings: list[Finding] = []
-    # jit purity and determinism need the whole module set up front
-    # (cross-module call resolution); the asyncio and race lints are
-    # per-file (the race lint's shared-state model keys on class names,
-    # so it is inert outside the control plane by construction).
-    if not determinism_only:
+    run_every = not determinism_only and not donation_only
+    # jit purity, determinism and donation need the whole module set up
+    # front (cross-module call resolution); the asyncio and race lints
+    # are per-file (the race lint's shared-state model keys on class
+    # names, so it is inert outside the control plane by construction).
+    if run_every:
         jit_pass = JitPurityPass(files, repo_root=REPO_ROOT)
         findings.extend(jit_pass.run())
-    findings.extend(DeterminismPass(files, repo_root=REPO_ROOT).run())
+    if not donation_only:
+        findings.extend(DeterminismPass(files, repo_root=REPO_ROOT).run())
     if not determinism_only:
+        findings.extend(DonationPass(files, repo_root=REPO_ROOT).run())
+    if run_every:
         for f in files:
             findings.extend(asyncio_lint_file(f, repo_root=REPO_ROOT))
             findings.extend(race_lint_file(f, repo_root=REPO_ROOT))
@@ -159,16 +177,20 @@ def run_all(
     baseline_path: Optional[str] = None,
     shape_audit: bool = True,
     retrace: bool = False,
+    membudget: bool = False,
     determinism_only: bool = False,
+    donation_only: bool = False,
 ) -> AnalysisResult:
-    """Lints + (optionally) the eval_shape audit and the retrace-budget
-    check, folded through the baseline.  The CLI and the CI gate both
-    call this."""
+    """Lints + (optionally) the eval_shape audit, the retrace-budget
+    check and the HBM-budget check, folded through the baseline.  The
+    CLI and the CI gate both call this."""
     from .baseline import Baseline
 
-    findings, nfiles = run_lints(paths, determinism_only=determinism_only)
+    findings, nfiles = run_lints(paths, determinism_only=determinism_only,
+                                 donation_only=donation_only)
     shape_entries = 0
     retrace_entries = 0
+    membudget_entries = 0
     errors: list[str] = []
     if shape_audit:
         from .shape_audit import run_shape_audit
@@ -187,6 +209,15 @@ def run_all(
         except Exception as e:
             errors.append(
                 f"retrace check crashed: {type(e).__name__}: {e}")
+    if membudget:
+        from .membudget import run_membudget_check
+
+        try:
+            mb_findings, membudget_entries = run_membudget_check()
+            findings.extend(mb_findings)
+        except Exception as e:
+            errors.append(
+                f"membudget check crashed: {type(e).__name__}: {e}")
 
     if baseline_path is None:
         baseline_path = os.path.join(
@@ -200,5 +231,6 @@ def run_all(
         checked_files=nfiles,
         shape_entries=shape_entries,
         retrace_entries=retrace_entries,
+        membudget_entries=membudget_entries,
         errors=errors,
     )

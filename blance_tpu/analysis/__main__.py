@@ -3,12 +3,13 @@
 Exit status is the contract CI consumes: 0 when every finding is either
 fixed or pinned in analysis/baseline.toml, nonzero when any NEW finding
 exists (or an analyzer itself crashed).  ``--ci`` is the full gate (AST
-lints + eval_shape audit + the device retrace-budget check) and
-additionally promotes stale baseline entries to hard errors, so a fix
-that removes a finding must delete its suppression in the same change;
-the default run skips the shape audit and retrace check so the editor
-loop stays sub-second and jax-import-free (``--shape-audit`` /
-``--retrace`` force them back on individually).
+lints + eval_shape audit + the device retrace-budget check + the AOT
+HBM-budget check) and additionally promotes stale baseline entries to
+hard errors, so a fix that removes a finding must delete its
+suppression in the same change; the default run skips the shape audit
+and the retrace/membudget checks so the editor loop stays sub-second
+and jax-import-free (``--shape-audit`` / ``--retrace`` /
+``--membudget`` force them back on individually).
 """
 
 from __future__ import annotations
@@ -41,6 +42,14 @@ def main(argv=None) -> int:
                     help="run ONLY the replay-determinism pass "
                          "(analysis/determinism.py), still folded "
                          "through the baseline")
+    ap.add_argument("--donation", action="store_true",
+                    help="run ONLY the use-after-donation pass "
+                         "(analysis/donation.py), still folded "
+                         "through the baseline")
+    ap.add_argument("--membudget", action="store_true",
+                    help="run the AOT HBM-budget check "
+                         "(analysis/membudget.py) without the rest of "
+                         "the --ci strictness")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="allowlist file (default: "
                          "blance_tpu/analysis/baseline.toml)")
@@ -50,9 +59,11 @@ def main(argv=None) -> int:
                     help="machine-readable output (one JSON object)")
     args = ap.parse_args(argv)
 
-    shape = (args.ci or args.shape_audit) and not args.determinism
-    retrace = (args.ci or args.retrace) and not args.determinism
-    if shape or retrace:
+    only_mode = args.determinism or args.donation
+    shape = (args.ci or args.shape_audit) and not only_mode
+    retrace = (args.ci or args.retrace) and not only_mode
+    membudget = (args.ci or args.membudget) and not only_mode
+    if shape or retrace or membudget:
         # The sharded contracts want a multi-device mesh; force 8 virtual
         # CPU devices BEFORE jax first imports (same trick as
         # tests/conftest.py).  No-op when jax is already in.
@@ -70,14 +81,21 @@ def main(argv=None) -> int:
         baseline_path=("/dev/null" if args.no_baseline else args.baseline),
         shape_audit=shape,
         retrace=retrace,
+        membudget=membudget,
         determinism_only=args.determinism,
+        donation_only=args.donation,
     )
 
     if args.determinism:
-        # Only the determinism pass ran: JIT/ASY/RACE pins are unused by
-        # construction, not stale.
+        # Only the determinism pass ran: JIT/ASY/RACE/DON pins are
+        # unused by construction, not stale.
         result.unused_baseline = [
             e for e in result.unused_baseline if e.rule.startswith("DET")]
+    if args.donation:
+        # Only the donation pass ran: every other pass's pins are
+        # unused by construction, not stale.
+        result.unused_baseline = [
+            e for e in result.unused_baseline if e.rule.startswith("DON")]
 
     # Stale pins are warnings in the editor loop but HARD ERRORS under
     # --ci: a fixed finding must delete its suppression in the same
@@ -96,6 +114,7 @@ def main(argv=None) -> int:
             "checked_files": result.checked_files,
             "shape_entries": result.shape_entries,
             "retrace_entries": result.retrace_entries,
+            "membudget_entries": result.membudget_entries,
             "errors": result.errors,
             "pass": not failed,
         }, indent=2, sort_keys=True))
@@ -113,6 +132,7 @@ def main(argv=None) -> int:
         print(f"blance_tpu.analysis: {result.checked_files} files, "
               f"{result.shape_entries} shape contracts, "
               f"{result.retrace_entries} retrace budgets, "
+              f"{result.membudget_entries} HBM budgets, "
               f"{len(result.new)} new finding(s), {n_base} baselined"
               + (" — FAIL" if failed else " — OK"))
     return 1 if failed else 0

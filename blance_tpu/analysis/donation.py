@@ -1,0 +1,776 @@
+"""Donation lint: static use-after-donation detection (DON00x).
+
+The fused pipeline's perf story rests on buffer donation (plan/tensor.py:
+``prev`` and the consumed carry table are XLA-aliased into the outputs),
+and donation has a property no test tier catches: on CPU it is a warning
+and a silent copy, on device backends it invalidates the operand buffer.
+PR 11's post-review found two live use-after-donation reads that every
+CPU run sailed through.  This pass is the static gate: the donation
+contract becomes build-failing findings the moment they are written
+(TOAST's thesis, arXiv:2508.15010 — partitioning-system invariants
+belong to principled static analysis, not review memory).
+
+The pass builds the shared :class:`._astutil.ModuleIndex`, then
+
+1. resolves every donating callable: module-level
+   ``f = jax.jit(impl, donate_argnames=...)`` bindings (and their plain
+   aliases), ``partial(jax.jit, ...)`` application, and
+   ``@jax.jit(...)`` / ``@partial(jax.jit, ...)`` decorators, with
+   ``donate_argnums`` mapped to parameter names through the wrapped
+   function's positional signature;
+2. runs a linear execution-order liveness walk over every function
+   (nested defs are fresh scopes), tracking value identity through
+   rebinds (generation counters), zero-copy device aliases
+   (``jnp.asarray`` / ``jax.device_put``), tuple packing for ``*args``
+   splats, and attribute roots (``self.current``, ``carry.used``).
+
+Rules:
+
+- **DON001** read of a donated operand after its donating dispatch —
+  including reads through aliases, attribute roots, packed argument
+  tuples, and values returned so callers can re-read them (the exact
+  PR-11 bug shape).  On a device backend that buffer is gone.
+- **DON002** a donated operand escapes before the dispatch — stored to
+  ``self.*``/an outer container or handed to a ``self.*`` store method
+  (the CarryCache/EncodeCache risk surface): another window now holds a
+  reference the dispatch invalidates.
+- **DON003** the same value dispatched through a donating callable
+  twice without rebinding — the second dispatch donates an
+  already-invalidated buffer.
+- **DON004** host snapshot (``np.asarray`` / ``.copy()``) of a donated
+  operand AFTER its dispatch: the snapshot reads invalidated memory.
+  The same snapshot BEFORE the dispatch is the sanctioned fix recipe
+  (``prev_fb = np.asarray(prev) if donate else prev``) and is
+  recognized as producing a fresh value, exempt from every rule.
+- **DON000** file does not parse (the shared parse-error funnel).
+
+Conservative exemptions keep the signal clean: ``.shape``/``.dtype``
+metadata reads survive donation (the aval outlives the buffer) and a
+conditional snapshot arm (the ``if donate else`` idiom) makes the bound
+name a fresh value.  Findings fold through ``analysis/baseline.toml``
+exactly like JIT/ASY/RACE/DET rules; the package itself carries zero.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from . import Finding
+from ._astutil import FuncInfo, ModuleIndex, ModuleInfo
+from ._astutil import dotted as _dotted
+from .jit_purity import _literal_ints, _literal_strings
+
+__all__ = ["DonationPass", "DonatingCallable"]
+
+# A value identity: a root key (("name", "prev") / ("attr", "self.current"))
+# plus a rebind generation — rebinding bumps the generation, so a donated
+# vid never matches the freshly bound value under the same name.
+_Key = tuple[str, str]
+_Vid = tuple[_Key, int]
+
+#: Zero-copy device aliases: the result shares the operand's buffer when
+#: it is already on device, so donating the result donates the operand.
+_ALIAS_FQS = frozenset({
+    "jax.numpy.asarray",
+    "jax.numpy.ascontiguousarray",
+    "jax.device_put",
+})
+
+#: Host snapshots: the result is a fresh host copy, never aliased —
+#: donating after one is safe, snapshotting a donated value is not.
+_SNAPSHOT_FQS = frozenset({
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "numpy.copy",
+    "jax.device_get",
+})
+
+#: Attribute reads that survive donation: jax keeps the aval (shape,
+#: dtype, sharding metadata) alive after the buffer is invalidated.
+_METADATA_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "device", "aval", "weak_type", "is_deleted",
+})
+
+#: Store methods on ``self.*`` receivers that publish a reference to a
+#: longer-lived container (the CarryCache/EncodeCache surface).
+_ESCAPE_METHODS = frozenset({
+    "store", "store_pending", "promote", "append", "add", "put",
+    "update", "setdefault", "push", "cache",
+})
+
+
+@dataclass(frozen=True)
+class DonatingCallable:
+    """One jit-wrapped callable with donated parameters resolved."""
+
+    fq: str  # fully-qualified name the dispatch sites call
+    line: int
+    params: tuple[str, ...]  # wrapped function's full parameter order
+    donated: tuple[str, ...]  # donated parameter names
+
+
+def _is_jit_ref(index: ModuleIndex, mi: ModuleInfo, node: ast.AST) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    fq = index.resolve(mi, d)
+    return fq in ("jax.jit", "jax.pjit", "jax.jit.jit") or \
+        (fq.endswith(".jit") and fq.startswith("jax"))
+
+
+class DonationPass:
+    """Whole-program pass: index, resolve donating callables, run the
+    liveness walk over every function body."""
+
+    def __init__(self, files: list[str], repo_root: str) -> None:
+        self.index = ModuleIndex(files, repo_root)
+        self.findings: list[Finding] = []
+        self.registry: dict[str, DonatingCallable] = {}
+        for rel, line, msg in self.index.parse_errors:
+            self.findings.append(Finding(
+                rule="DON000", path=rel, line=line, symbol="",
+                message=f"file does not parse: {msg}"))
+
+    # -- donating-callable discovery ----------------------------------------
+
+    def _wrapped_info(self, mi: ModuleInfo,
+                      node: ast.AST) -> Optional[FuncInfo]:
+        """The function a jit wraps: a dotted reference or a one-level
+        ``partial(f, ...)``."""
+        if isinstance(node, ast.Call):
+            return self.index.partial_target(mi, node)
+        d = _dotted(node)
+        if d is None:
+            return None
+        return self.index.lookup_function(mi, d)
+
+    def _donated_params(self, mi: ModuleInfo, keywords: list[ast.keyword],
+                        wrapped: Optional[FuncInfo]) -> list[str]:
+        out: list[str] = []
+        for kw in keywords:
+            if kw.arg == "donate_argnames":
+                names = _literal_strings(kw.value, mi.constants)
+                if names:
+                    out.extend(n for n in names if n not in out)
+            elif kw.arg == "donate_argnums" and wrapped is not None:
+                nums = _literal_ints(kw.value, mi.constants)
+                fnode = wrapped.node
+                if nums is None or not isinstance(
+                        fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                args = fnode.args
+                pos = [a.arg for a in args.posonlyargs] + \
+                    [a.arg for a in args.args]
+                for i in nums:
+                    if 0 <= i < len(pos) and pos[i] not in out:
+                        out.append(pos[i])
+        return out
+
+    def _donating_from_value(self, mi: ModuleInfo,
+                             value: ast.expr) -> Optional[DonatingCallable]:
+        """``jax.jit(f, donate_*=...)`` or
+        ``partial(jax.jit, donate_*=...)(f)`` as an assigned value."""
+        if not isinstance(value, ast.Call):
+            return None
+        if _is_jit_ref(self.index, mi, value.func) and value.args:
+            wrapped = self._wrapped_info(mi, value.args[0])
+            donated = self._donated_params(mi, value.keywords, wrapped)
+            if donated and wrapped is not None:
+                return DonatingCallable(
+                    fq=wrapped.fq, line=value.lineno,
+                    params=tuple(wrapped.params), donated=tuple(donated))
+            return None
+        inner = value.func
+        if isinstance(inner, ast.Call) and inner.args and \
+                _is_jit_ref(self.index, mi, inner.args[0]) and \
+                self.index.resolve(mi, _dotted(inner.func) or "") == \
+                "functools.partial" and value.args:
+            wrapped = self._wrapped_info(mi, value.args[0])
+            donated = self._donated_params(mi, inner.keywords, wrapped)
+            if donated and wrapped is not None:
+                return DonatingCallable(
+                    fq=wrapped.fq, line=value.lineno,
+                    params=tuple(wrapped.params), donated=tuple(donated))
+        return None
+
+    def _donating_from_decorator(
+            self, mi: ModuleInfo, fn: FuncInfo,
+            dec: ast.AST) -> Optional[DonatingCallable]:
+        if not isinstance(dec, ast.Call):
+            return None
+        keywords: Optional[list[ast.keyword]] = None
+        if _is_jit_ref(self.index, mi, dec.func):  # @jax.jit(...)
+            keywords = dec.keywords
+        elif dec.args and _is_jit_ref(self.index, mi, dec.args[0]) and \
+                self.index.resolve(mi, _dotted(dec.func) or "") == \
+                "functools.partial":  # @partial(jax.jit, ...)
+            keywords = dec.keywords
+        if keywords is None:
+            return None
+        donated = self._donated_params(mi, keywords, fn)
+        fnode = fn.node
+        if not donated or not isinstance(
+                fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        return DonatingCallable(
+            fq=fn.fq, line=fnode.lineno, params=tuple(fn.params),
+            donated=tuple(donated))
+
+    def _build_registry(self) -> None:
+        for mi in self.index.modules.values():
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    dc = self._donating_from_value(mi, node.value)
+                    if dc is not None:
+                        self.registry[
+                            f"{mi.name}.{node.targets[0].id}"] = dc
+            for fn in mi.functions.values():
+                if not isinstance(
+                        fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for dec in fn.node.decorator_list:
+                    dc = self._donating_from_decorator(mi, fn, dec)
+                    if dc is not None:
+                        self.registry[fn.fq] = dc
+        # Plain aliases of donating bindings (one propagation round:
+        # ``impl = _warm_repair_donating`` at module level).
+        for mi in self.index.modules.values():
+            for node in ast.walk(mi.tree):
+                if not (isinstance(node, ast.Assign) and
+                        len(node.targets) == 1 and
+                        isinstance(node.targets[0], ast.Name)):
+                    continue
+                d = _dotted(node.value)
+                if d is None:
+                    continue
+                dc = self._registry_lookup(mi, d)
+                if dc is not None:
+                    self.registry.setdefault(
+                        f"{mi.name}.{node.targets[0].id}", dc)
+
+    def _registry_lookup(self, mi: ModuleInfo,
+                         dotted_ref: str) -> Optional[DonatingCallable]:
+        local = f"{mi.name}.{dotted_ref}"
+        if local in self.registry:
+            return self.registry[local]
+        return self.registry.get(self.index.resolve(mi, dotted_ref))
+
+    # -- driver -------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._build_registry()
+        for mi in self.index.modules.values():
+            for fn in mi.functions.values():
+                node = fn.node
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._lint_body(mi, fn.path, fn.qualname, node.body)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return self.findings
+
+    def _lint_body(self, mi: ModuleInfo, path: str, symbol: str,
+                   body: list[ast.stmt]) -> None:
+        _ScopeLinter(self, mi, path, symbol).run(body)
+
+
+def _walk_no_nested(nodes: Sequence[ast.AST]) -> list[ast.AST]:
+    """All nodes under ``nodes`` except nested function/class bodies
+    (those are linted as their own scopes)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _ScopeLinter:
+    """Linear execution-order liveness walk over one function body.
+
+    Path-insensitive: branches are walked in source order and their
+    effects accumulate — sound for the dispatch helpers this pass
+    guards, whose donating call happens exactly once per scope, and
+    conservative everywhere else (a read in EITHER branch after a
+    dispatch in EITHER branch is flagged)."""
+
+    def __init__(self, owner: DonationPass, mi: ModuleInfo, path: str,
+                 symbol: str) -> None:
+        self.owner = owner
+        self.mi = mi
+        self.path = path
+        self.symbol = symbol
+        self.gen: dict[_Key, int] = {}
+        # name -> vid of the value it aliases (x = jnp.asarray(prev))
+        self.alias_of: dict[str, _Vid] = {}
+        # name -> element exprs of a tuple literal (for *args splats)
+        self.tuple_bind: dict[str, list[ast.expr]] = {}
+        # vid -> (time, line, callee fq, donated param name)
+        self.donated: dict[_Vid, tuple[int, int, str, str]] = {}
+        # (vid, time, line, where) — judged against dispatch times at end
+        self.escapes: list[tuple[_Vid, int, int, str]] = []
+        self.time = 0
+        self.callable_aliases: dict[str, DonatingCallable] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        self.owner.findings.append(Finding(
+            rule=rule, path=self.path, line=line, symbol=self.symbol,
+            message=message))
+
+    def _vid(self, key: _Key) -> _Vid:
+        return (key, self.gen.get(key, 0))
+
+    def _describe(self, vid: _Vid) -> str:
+        return vid[0][1]
+
+    # -- value identity -----------------------------------------------------
+
+    def _unwrap_alias(self, expr: ast.expr) -> ast.expr:
+        while isinstance(expr, ast.Call) and len(expr.args) == 1 and \
+                not expr.keywords:
+            d = _dotted(expr.func)
+            if d is None or \
+                    self.owner.index.resolve(self.mi, d) not in _ALIAS_FQS:
+                break
+            expr = expr.args[0]
+        return expr
+
+    def _is_snapshot_call(self, expr: ast.expr) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "copy" and not expr.args:
+            return True
+        d = _dotted(expr.func)
+        return d is not None and \
+            self.owner.index.resolve(self.mi, d) in _SNAPSHOT_FQS
+
+    def _value_id(self, expr: ast.expr) -> Optional[_Vid]:
+        """The tracked identity of the buffer ``expr`` evaluates to, or
+        None for fresh values (snapshots, computed results)."""
+        expr = self._unwrap_alias(expr)
+        if self._is_snapshot_call(expr):
+            return None
+        if isinstance(expr, ast.IfExp):
+            # ``np.asarray(x) if donate else x``: whichever arm runs,
+            # the name is safe to read post-dispatch exactly when the
+            # snapshot arm covers the donating case — the sanctioned
+            # fix idiom.  A snapshot in either arm makes the value
+            # fresh.
+            if self._is_snapshot_call(self._unwrap_alias(expr.body)) or \
+                    self._is_snapshot_call(self._unwrap_alias(expr.orelse)):
+                return None
+            return self._value_id(expr.body)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.alias_of:
+                return self.alias_of[expr.id]
+            return self._vid(("name", expr.id))
+        if isinstance(expr, ast.Attribute):
+            d = _dotted(expr)
+            if d is not None:
+                return self._vid(("attr", d))
+        return None
+
+    # -- rebinding ----------------------------------------------------------
+
+    def _bump_prefixed(self, root: str) -> None:
+        """Rebinding ``carry`` also retires ``carry.used``'s identity —
+        donated entries keep their old (key, generation) vid, which no
+        fresh read can match."""
+        prefix = root + "."
+        for key in list(self.gen):
+            if key[0] == "attr" and key[1].startswith(prefix):
+                self.gen[key] += 1
+
+    def _rebind_name(self, name: str) -> None:
+        self.alias_of.pop(name, None)
+        self.tuple_bind.pop(name, None)
+        key: _Key = ("name", name)
+        self.gen[key] = self.gen.get(key, 0) + 1
+        self._bump_prefixed(name)
+
+    def _rebind_chain(self, dotted_ref: str) -> None:
+        key: _Key = ("attr", dotted_ref)
+        self.gen[key] = self.gen.get(key, 0) + 1
+        self._bump_prefixed(dotted_ref)
+
+    def _rebind_target(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self._rebind_name(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._rebind_target(elt)
+        elif isinstance(target, ast.Starred):
+            self._rebind_target(target.value)
+        elif isinstance(target, ast.Attribute):
+            d = _dotted(target)
+            if d is not None:
+                self._rebind_chain(d)
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, body: list[ast.stmt]) -> None:
+        self.callable_aliases = self._scope_callable_aliases(body)
+        self._stmts(body)
+        self._finalize()
+
+    def _scope_callable_aliases(
+            self, body: list[ast.stmt]) -> dict[str, DonatingCallable]:
+        """``impl = _warm_repair_donating if donate else _warm_repair_jit``
+        (either arm donating) and plain ``impl = _x_donating`` bindings,
+        prescanned so dispatch-through-alias resolves."""
+        out: dict[str, DonatingCallable] = {}
+        for node in _walk_no_nested(body):
+            if not (isinstance(node, ast.Assign) and
+                    len(node.targets) == 1 and
+                    isinstance(node.targets[0], ast.Name)):
+                continue
+            value = node.value
+            arms = [value.body, value.orelse] \
+                if isinstance(value, ast.IfExp) else [value]
+            for arm in arms:
+                d = _dotted(arm)
+                if d is None:
+                    continue
+                dc = self.owner._registry_lookup(self.mi, d)
+                if dc is not None:
+                    out[node.targets[0].id] = dc
+                    break
+        return out
+
+    def _stmts(self, body: list[ast.stmt]) -> None:
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        self.time += 1
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.owner._lint_body(
+                self.mi, self.path, f"{self.symbol}.{st.name}", st.body)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self._expr(st.value)
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value)
+            for t in st.targets:
+                self._assign_target(t, st.value, st.lineno)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value)
+                self._assign_target(st.target, st.value, st.lineno)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._expr(st.value)
+            if isinstance(st.target, ast.Name):
+                self._check_name_read(st.target.id, st.lineno)
+                self._rebind_name(st.target.id)
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value)
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter)
+            self._rebind_target(st.target)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._rebind_target(item.optional_vars)
+            self._stmts(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for handler in st.handlers:
+                self._stmts(handler.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+            return
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc)
+            return
+        if isinstance(st, ast.Assert):
+            self._expr(st.test)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._rebind_target(t)
+            return
+        if isinstance(st, ast.Match):
+            self._expr(st.subject)
+            for case in st.cases:
+                self._stmts(case.body)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _assign_target(self, target: ast.expr, value: ast.expr,
+                       line: int) -> None:
+        if isinstance(target, ast.Name):
+            self._rebind_name(target.id)
+            if isinstance(value, ast.Tuple):
+                self.tuple_bind[target.id] = list(value.elts)
+                return
+            unwrapped = self._unwrap_alias(value)
+            if isinstance(unwrapped, ast.IfExp) or \
+                    isinstance(unwrapped, (ast.Name, ast.Attribute)):
+                vid = self._value_id(value)
+                if vid is not None:
+                    self.alias_of[target.id] = vid
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._rebind_target(elt)
+            return
+        if isinstance(target, ast.Attribute):
+            d = _dotted(target)
+            if d is not None:
+                self._rebind_chain(d)
+            self._record_escape(value, line,
+                                d if d is not None else "an attribute")
+            return
+        if isinstance(target, ast.Subscript):
+            base = _dotted(target.value)
+            self._record_escape(
+                value, line,
+                f"{base}[...]" if base is not None else "a container")
+            return
+        if isinstance(target, ast.Starred):
+            self._rebind_target(target.value)
+
+    def _record_escape(self, value: ast.expr, line: int,
+                       where: str) -> None:
+        vid = self._value_id(value)
+        if vid is not None:
+            self.escapes.append((vid, self.time, line, where))
+
+    # -- expression side: reads, snapshots, dispatches ----------------------
+
+    def _expr(self, expr: ast.expr) -> None:
+        self._read_walk(expr)
+        for node in _walk_no_nested([expr]):
+            if isinstance(node, ast.Call):
+                dc = self._donating_callee(node)
+                if dc is not None:
+                    self._dispatch(node, dc)
+                else:
+                    self._call_escapes(node)
+
+    def _donating_callee(self,
+                         call: ast.Call) -> Optional[DonatingCallable]:
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        if d in self.callable_aliases:
+            return self.callable_aliases[d]
+        return self.owner._registry_lookup(self.mi, d)
+
+    def _donated_arg_exprs(
+            self, call: ast.Call,
+            dc: DonatingCallable) -> list[tuple[str, ast.expr, int]]:
+        """(param, argument expr, line) per donated parameter bound at
+        this call, expanding ``*tuple_name`` splats through tuple-literal
+        bindings."""
+        pos: list[Optional[ast.expr]] = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                if isinstance(a.value, ast.Name) and \
+                        a.value.id in self.tuple_bind:
+                    pos.extend(self.tuple_bind[a.value.id])
+                else:
+                    break  # opaque splat: positions beyond it unknown
+            else:
+                pos.append(a)
+        out: list[tuple[str, ast.expr, int]] = []
+        for i, param in enumerate(dc.params):
+            if param in dc.donated and i < len(pos):
+                arg = pos[i]
+                if arg is not None:
+                    out.append((param, arg, call.lineno))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in dc.donated:
+                out.append((kw.arg, kw.value, call.lineno))
+        return out
+
+    def _dispatch(self, call: ast.Call, dc: DonatingCallable) -> None:
+        for param, arg, line in self._donated_arg_exprs(call, dc):
+            vid = self._value_id(arg)
+            if vid is None:
+                continue  # fresh value (snapshot/computed): safe donation
+            prior = self.donated.get(vid)
+            if prior is not None:
+                self._emit(
+                    "DON003", line,
+                    f"{self._describe(vid)!r} is dispatched through "
+                    f"donating callable {dc.fq} but was already donated "
+                    f"at line {prior[1]} (to {prior[2]}) without being "
+                    f"rebound — the second dispatch donates an "
+                    f"invalidated buffer")
+            self.donated[vid] = (self.time, line, dc.fq, param)
+
+    def _call_escapes(self, call: ast.Call) -> None:
+        """``self.cache.store(prev)``-shaped publication of a reference
+        into longer-lived state."""
+        if not (isinstance(call.func, ast.Attribute) and
+                call.func.attr in _ESCAPE_METHODS):
+            return
+        receiver = _dotted(call.func.value)
+        if receiver is None or \
+                receiver.split(".")[0] not in ("self", "cls"):
+            return
+        where = f"{receiver}.{call.func.attr}()"
+        for arg in list(call.args) + \
+                [kw.value for kw in call.keywords if kw.arg is not None]:
+            self._record_escape(arg, call.lineno, where)
+
+    # -- reads --------------------------------------------------------------
+
+    def _don001(self, vid: _Vid, line: int, what: str) -> None:
+        _t, dline, callee, param = self.donated[vid]
+        self._emit(
+            "DON001", line,
+            f"{what} after its donating dispatch to {callee} at line "
+            f"{dline} (donated as {param!r}) — the buffer is invalidated "
+            f"on device backends (CPU only warns); snapshot host-side "
+            f"before the dispatch (np.asarray) or rebind the name")
+
+    def _check_name_read(self, name: str, line: int) -> None:
+        for vid in (self._vid(("name", name)), self.alias_of.get(name)):
+            if vid is not None and vid in self.donated:
+                self._don001(vid, line, f"reads {name!r}")
+                return
+        for elt in self.tuple_bind.get(name, []):
+            vid = self._value_id(elt)
+            if vid is not None and vid in self.donated:
+                self._don001(
+                    vid, line,
+                    f"reads {name!r}, which packs donated operand "
+                    f"{self._describe(vid)!r},")
+                return
+
+    def _check_chain_read(self, dotted_ref: str, line: int) -> None:
+        parts = dotted_ref.split(".")
+        head = parts[0]
+        self._check_name_read(head, line)
+        for cut in range(2, len(parts) + 1):
+            prefix = ".".join(parts[:cut])
+            vid = self._vid(("attr", prefix))
+            if vid in self.donated:
+                self._don001(vid, line, f"reads {dotted_ref!r}")
+                return
+
+    def _read_walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Call):
+            # Host snapshot of a donated value: DON004, not DON001 —
+            # the recipe is right, the placement (after the dispatch)
+            # is the bug.
+            snap_arg: Optional[ast.expr] = None
+            if self._is_snapshot_call(node):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "copy" and not node.args:
+                    snap_arg = node.func.value
+                elif node.args:
+                    snap_arg = node.args[0]
+            if snap_arg is not None:
+                vid = self._value_id(snap_arg)
+                if vid is not None and vid in self.donated:
+                    _t, dline, callee, _param = self.donated[vid]
+                    self._emit(
+                        "DON004", node.lineno,
+                        f"host snapshot of donated operand "
+                        f"{self._describe(vid)!r} AFTER its donating "
+                        f"dispatch to {callee} at line {dline} — the "
+                        f"snapshot reads invalidated memory; move it "
+                        f"before the dispatch (the "
+                        f"`np.asarray(x) if donate else x` idiom)")
+                    for rest in node.args[1:]:
+                        self._read_walk(rest)
+                    return
+            # The donated arguments of a donating dispatch ARE the
+            # donation, not a use-after — suppress their root reads so
+            # a re-dispatch reports one DON003, not DON001 noise on top.
+            skip: set[int] = set()
+            dc = self._donating_callee(node)
+            if dc is not None:
+                skip = {id(arg) for _p, arg, _l
+                        in self._donated_arg_exprs(node, dc)}
+            self._read_walk(node.func)
+            for a in node.args:
+                if dc is not None and isinstance(a, ast.Starred):
+                    continue  # splat elements are covered by _dispatch
+                if id(a) not in skip:
+                    self._read_walk(a)
+            for kw in node.keywords:
+                if id(kw.value) not in skip:
+                    self._read_walk(kw.value)
+            return
+        if isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            if d is not None:
+                if node.attr in _METADATA_ATTRS:
+                    return  # shape/dtype metadata outlives the buffer
+                self._check_chain_read(d, node.lineno)
+                return
+            self._read_walk(node.value)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self._check_name_read(node.id, node.lineno)
+            return
+        if isinstance(node, ast.IfExp):
+            # Arms of the conditional-snapshot idiom are handled by
+            # _value_id; reads inside still count.
+            self._read_walk(node.test)
+            self._read_walk(node.body)
+            self._read_walk(node.orelse)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._read_walk(child)
+
+    # -- scope end ----------------------------------------------------------
+
+    def _finalize(self) -> None:
+        for vid, t, line, where in self.escapes:
+            record = self.donated.get(vid)
+            if record is not None and t < record[0]:
+                self._emit(
+                    "DON002", line,
+                    f"donated operand {self._describe(vid)!r} escapes "
+                    f"into {where} before its donating dispatch to "
+                    f"{record[2]} at line {record[1]} — the stored "
+                    f"reference observes an invalidated buffer after "
+                    f"the dispatch; store a host snapshot "
+                    f"(np.asarray) or store the dispatch output instead")

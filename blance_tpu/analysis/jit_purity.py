@@ -28,8 +28,12 @@ the idiom parallel/sharded.py uses), then walks the reachable set:
   Traced mutations of captured Python state run ONCE, at trace time.
 - JIT005 (jit call sites): static-arg hygiene — ``static_argnames`` /
   ``donate_argnames`` naming a parameter the wrapped function does not
-  have (jit raises only when the name is actually passed), and static
-  parameters whose declared default is an unhashable literal.
+  have (jit raises only when the name is actually passed), static
+  parameters whose declared default is an unhashable literal, and
+  ``donate_argnums`` indices that fall outside the wrapped function's
+  positional parameters or land on a declared static (jax rejects both
+  only at dispatch time, so the misdeclaration hides until a call site
+  exercises it).
 
 Helpers reached from a root get JIT001/JIT004 only: without the root's
 ``static_argnames`` there is no ground truth for which helper parameters
@@ -96,6 +100,36 @@ def _literal_strings(node: ast.AST, constants: dict[str, object]
                 return None
         return out
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    return None
+
+
+def _literal_ints(node: ast.AST, constants: dict[str, object]
+                  ) -> Optional[list[int]]:
+    """Extract a tuple/list of int literals (or a single int), following
+    one level of module-constant indirection — the ``donate_argnums``
+    twin of :func:`_literal_strings`."""
+    if isinstance(node, ast.Name) and node.id in constants:
+        val = constants[node.id]
+        if isinstance(val, int) and not isinstance(val, bool):
+            return [val]
+        if isinstance(val, (tuple, list)) and \
+                all(isinstance(x, int) and not isinstance(x, bool)
+                    for x in val):
+            return [int(x) for x in val]
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, int) and \
+                    not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and \
+            not isinstance(node.value, bool):
         return [node.value]
     return None
 
@@ -178,7 +212,46 @@ class JitPurityPass:
                                 f"only raises when the name is passed, so "
                                 f"this typo hides until a call site uses "
                                 f"it"))
+        self._jit_argnums(mi, call, wrapped, statics)
         return statics
+
+    def _jit_argnums(self, mi: ModuleInfo, call: ast.Call, wrapped,
+                     statics: set[str]) -> None:
+        """donate_argnums hygiene: positional indices are resolved by jax
+        only at dispatch time, so an out-of-range index or one landing on
+        a declared static parameter (jax refuses to donate statics)
+        hides until a call site exercises the donating path."""
+        if wrapped is None:
+            return
+        args = wrapped.node.args
+        pos = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            nums = _literal_ints(kw.value, mi.constants)
+            if nums is None:
+                continue
+            for i in nums:
+                if i < 0 or i >= len(pos):
+                    self.findings.append(Finding(
+                        rule="JIT005", path=mi.path, line=call.lineno,
+                        symbol=wrapped.qualname,
+                        message=f"donate_argnums index {i} is outside "
+                                f"{wrapped.qualname}()'s "
+                                f"{len(pos)} positional parameter(s) — "
+                                f"jit only raises at dispatch time, so "
+                                f"the bad index hides until the donating "
+                                f"path runs"))
+                elif pos[i] in statics:
+                    self.findings.append(Finding(
+                        rule="JIT005", path=mi.path, line=call.lineno,
+                        symbol=wrapped.qualname,
+                        message=f"donate_argnums index {i} names "
+                                f"{pos[i]!r} which is also declared in "
+                                f"static_argnames — a static argument "
+                                f"has no device buffer to donate, and "
+                                f"jax rejects the overlap only at "
+                                f"dispatch time"))
 
     def _find_roots(self) -> None:
         for mi in self.modules.values():

@@ -19,7 +19,7 @@ iteration order.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -38,6 +38,14 @@ __all__ = ["DenseProblem", "encode_problem", "decode_assignment",
            "stack_problem_arrays", "pack_assignment_core",
            "pack_assignment", "prev_from_entries_core",
            "prev_from_entries", "pack_slot_rows", "strip_prev_rows"]
+
+# Host-side array annotation shorthand.  numpy's ndarray is generic
+# under the stubs, and every module under the mypy
+# disallow_any_generics ratchet must parameterize it at each spelling.
+# Dtype precision is not what that gate buys (the dense encoding is
+# int32/float32 by construction, asserted here at encode time) —
+# structural parameterization is.
+NPArray = np.ndarray[Any, np.dtype[Any]]
 
 # Shape-bucket granularity: buckets per power-of-two octave.  8 keeps the
 # worst-case padding overhead at 1/8 = 12.5% of the axis while collapsing

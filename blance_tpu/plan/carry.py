@@ -39,6 +39,8 @@ from typing import TYPE_CHECKING, Any, Optional
 
 import numpy as np
 
+from ..core.encode import NPArray
+
 if TYPE_CHECKING:  # annotation-only: keep jax imports lazy at runtime
     from .resident import EncodedState
     from .tensor import SolveCarry
@@ -69,8 +71,8 @@ def pad_carry_nodes(carry: Optional["SolveCarry"],
                       used=used)
 
 
-def effective_dirty(dirty: np.ndarray, current: np.ndarray,
-                    constraints: "np.ndarray | tuple") -> np.ndarray:
+def effective_dirty(dirty: NPArray, current: NPArray,
+                    constraints: "NPArray | tuple[int, ...]") -> NPArray:
     """The replan-time dirty mask: accumulated delta rows plus any
     partition with an unfilled constrained slot (it must bid).  Pure
     function of the mask, the live assignment and the per-state slot
@@ -85,13 +87,13 @@ def effective_dirty(dirty: np.ndarray, current: np.ndarray,
 
 
 def capacity_shrank(
-    used: np.ndarray,  # [S, N] the carry's per-state per-node fill
-    current: np.ndarray,  # [P, S, R] the assignment the carry matches
-    partition_weights: np.ndarray,  # [P]
-    node_weights: np.ndarray,  # [N]
-    valid_node: np.ndarray,  # [N]
-    constraints: "np.ndarray | tuple",  # [S]
-    dirty: np.ndarray,  # [P] effective dirty mask
+    used: NPArray,  # [S, N] the carry's per-state per-node fill
+    current: NPArray,  # [P, S, R] the assignment the carry matches
+    partition_weights: NPArray,  # [P]
+    node_weights: NPArray,  # [N]
+    valid_node: NPArray,  # [N]
+    constraints: "NPArray | tuple[int, ...]",  # [S]
+    dirty: NPArray,  # [P] effective dirty mask
     shards: int = 1,
 ) -> bool:
     """True when some node's clean-row held weight exceeds its new
@@ -156,7 +158,7 @@ class CarryEntry:
 
     def __init__(self, partitions: int) -> None:
         self.carry: Optional["SolveCarry"] = None
-        self.current: Optional[np.ndarray] = None
+        self.current: Optional[NPArray] = None
         self.pending: Optional["SolveCarry"] = None
         self.dirty = np.zeros(partitions, bool)
         self.dirty_post = np.zeros(partitions, bool)
@@ -377,7 +379,7 @@ class CarryCache:
         if e is not None:
             self._bytes -= e.nbytes()
 
-    def mark_dirty(self, key: str, mask: np.ndarray,
+    def mark_dirty(self, key: str, mask: NPArray,
                    pending: bool) -> None:
         """Record delta marks.  With ``pending`` (a proposal is in
         flight) marks land in the post-proposal mask: the pending solve
@@ -419,8 +421,8 @@ class CarryCache:
         self._enforce_budget()
 
     def consume(
-        self, key: str, current: np.ndarray, match: str = "identity",
-    ) -> tuple[Optional["SolveCarry"], np.ndarray]:
+        self, key: str, current: NPArray, match: str = "identity",
+    ) -> tuple[Optional["SolveCarry"], NPArray]:
         """Take the key's carry for a replan attempt, merging the
         post-proposal marks into the dirty mask (this solve absorbs
         every delta recorded so far).
@@ -462,7 +464,7 @@ class CarryCache:
             e.pending = carry
         self._enforce_budget()
 
-    def promote(self, key: str, current: np.ndarray) -> None:
+    def promote(self, key: str, current: NPArray) -> None:
         """Adopt the pending carry as the live warm-start state for
         ``current`` (the caller just adopted the proposal) and retire
         the absorbed delta marks; post-proposal marks roll forward."""
@@ -479,7 +481,7 @@ class CarryCache:
         self._enforce_budget()
 
     def store(self, key: str, carry: "SolveCarry",
-              current: np.ndarray) -> None:
+              current: NPArray) -> None:
         """Adopt ``carry`` directly as the live state for ``current``
         (the service's one-shot path: solve + adopt in one step), with
         clean masks — the solve absorbed everything."""

@@ -47,7 +47,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import NamedTuple, Optional, Sequence
+from typing import Any, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from ..core.encode import (
     DenseProblem,
+    NPArray,
     bucket_size,
     pad_problem_arrays,
     pad_to,
@@ -65,6 +66,8 @@ from ..obs import device as _device
 from ..obs import get_recorder
 from .carry import capacity_shrank, effective_dirty
 from .tensor import (
+    Constraints,
+    Rules,
     SolveCarry,
     _check_tier_band_scale,
     _solve_dense_converged_impl,
@@ -107,23 +110,23 @@ class TenantProblem:
     """
 
     key: str
-    prev: np.ndarray  # [P, S, R] int32, -1 empty
-    partition_weights: np.ndarray  # [P] float32
-    node_weights: np.ndarray  # [N] float32
-    valid_node: np.ndarray  # [N] bool
-    stickiness: np.ndarray  # [P, S] float32
-    gids: np.ndarray  # [L, N] int32
-    gid_valid: np.ndarray  # [L, N] bool
+    prev: NPArray  # [P, S, R] int32, -1 empty
+    partition_weights: NPArray  # [P] float32
+    node_weights: NPArray  # [N] float32
+    valid_node: NPArray  # [N] bool
+    stickiness: NPArray  # [P, S] float32
+    gids: NPArray  # [L, N] int32
+    gid_valid: NPArray  # [L, N] bool
     constraints: tuple[int, ...]
     rules: tuple[tuple[tuple[int, int], ...], ...]
     carry: Optional[SolveCarry] = None
-    dirty: Optional[np.ndarray] = None
+    dirty: Optional[NPArray] = None
 
     @classmethod
     def from_dense(cls, key: str, problem: DenseProblem,
                    carry: Optional[SolveCarry] = None,
-                   dirty: Optional[np.ndarray] = None,
-                   prev: Optional[np.ndarray] = None) -> "TenantProblem":
+                   dirty: Optional[NPArray] = None,
+                   prev: Optional[NPArray] = None) -> "TenantProblem":
         """Wrap an encoded DenseProblem (``prev`` overrides the encode-
         time seed — pass a session's live ``current``)."""
         return cls(
@@ -150,7 +153,7 @@ class FleetResult:
     """One tenant's solve outcome (arrays at the REAL, unpadded shape)."""
 
     key: str
-    assign: np.ndarray  # [P, S, R] int32
+    assign: NPArray  # [P, S, R] int32
     carry: Optional[SolveCarry]  # rebuilt warm-start state, real-N used
     warm: bool  # solved by an accepted one-sweep repair
     sweeps: int  # converged-loop passes executed
@@ -233,8 +236,8 @@ def _fleet_cold_batch(
     gids: jnp.ndarray,  # [B, L, N]
     gid_valid: jnp.ndarray,  # [B, L, N]
     p_real: jnp.ndarray,  # [B] f32 — real partition counts
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     max_iterations: int = 10,
     fused_score: str = "off",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -267,8 +270,8 @@ def _fleet_warm_batch(
     dirty: jnp.ndarray,  # [B, P] bool (pad rows True: not a ripple)
     carry_used: jnp.ndarray,  # [B, S, N]
     p_real: jnp.ndarray,  # [B]
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     fused_score: str = "off",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched one-sweep warm repair: (assign, used, ok) per element."""
@@ -285,11 +288,11 @@ def _fleet_warm_batch(
 # rebuilding jax.jit(shard_map(...)) per call would defeat the jit
 # cache.  Bounded: a fleet deployment has a handful of classes and one
 # mesh.
-_MESH_FN_CACHE: dict = {}
+_MESH_FN_CACHE: dict[tuple[object, ...], Any] = {}
 _MESH_FN_CACHE_MAX = 128
 
 
-def _mesh_callable(mesh, warm: bool, constraints: tuple, rules: tuple,
+def _mesh_callable(mesh, warm: bool, constraints: Constraints, rules: Rules,
                    max_iterations: int, fused_score: str):
     """jit(shard_map(vmap(solver))) with the batch axis sharded.
 
@@ -358,7 +361,7 @@ def _normalized(t: TenantProblem) -> TenantProblem:
 
 
 def _padded_solver_arrays(t: TenantProblem,
-                          k: BatchClass) -> tuple[np.ndarray, ...]:
+                          k: BatchClass) -> tuple[NPArray, ...]:
     """One tenant's arrays padded to its class shape (inert padding)."""
     return pad_problem_arrays(
         t.prev, t.partition_weights, t.node_weights, t.valid_node,
@@ -366,7 +369,7 @@ def _padded_solver_arrays(t: TenantProblem,
 
 
 def _warm_eligible(t: TenantProblem, rec,
-                   record: bool) -> Optional[np.ndarray]:
+                   record: bool) -> Optional[NPArray]:
     """The tenant's effective dirty mask when the warm path may run,
     else None (demoted to cold).  Mirrors PlannerSession.replan's
     gating: a carry + dirty mask must be present, the carry must match
@@ -394,8 +397,8 @@ def _warm_eligible(t: TenantProblem, rec,
     return dirty
 
 
-def _pad_batch(stacked: Sequence[np.ndarray],
-               b_target: int) -> tuple[list[np.ndarray], int]:
+def _pad_batch(stacked: Sequence[NPArray],
+               b_target: int) -> tuple[list[NPArray], int]:
     """Pad the batch axis to ``b_target`` by replicating the last
     element (a real problem solves to a real answer, discarded) —
     returns (padded arrays, padded B)."""
@@ -406,10 +409,10 @@ def _pad_batch(stacked: Sequence[np.ndarray],
     return [np.concatenate([a, a[reps]]) for a in stacked], b_target
 
 
-def _dispatch(fn_args: list[np.ndarray], mesh, warm: bool,
+def _dispatch(fn_args: list[NPArray], mesh, warm: bool,
               k: BatchClass, max_iterations: int, fused_score: str,
               rec, record: bool,
-              batch_floor: int = 1) -> tuple[np.ndarray, ...]:
+              batch_floor: int = 1) -> tuple[NPArray, ...]:
     """Run one class batch on device (vmapped; mesh-sharded when given);
     returns host arrays, batch padding stripped.
 
@@ -480,7 +483,7 @@ def _count_solve(rec, sweeps: int) -> None:
     rec.observe("plan.solve.sweeps", sweeps)
 
 
-def _real_carry(assign: np.ndarray, used_padded: np.ndarray,
+def _real_carry(assign: NPArray, used_padded: NPArray,
                 n_real: int) -> SolveCarry:
     """Strip node padding off a batched element's carry table.  Pad
     columns are invalid nodes with zero fill (inert-padding contract),
@@ -493,8 +496,8 @@ def _real_carry(assign: np.ndarray, used_padded: np.ndarray,
     return SolveCarry(prices=used.sum(axis=0), assign=assign, used=used)
 
 
-def _trace_attrs(trace_ids: Optional[dict],
-                 keys: Sequence[str]) -> dict:
+def _trace_attrs(trace_ids: Optional[dict[str, str]],
+                 keys: Sequence[str]) -> dict[str, str]:
     """Span attrs carrying the batch members' trace ids (capped: a
     thousand-tenant batch must not serialize a novel per span)."""
     if not trace_ids:
@@ -516,7 +519,7 @@ def solve_fleet(
     fused_score: Optional[str] = None,
     record: bool = True,
     recorder=None,
-    trace_ids: Optional[dict] = None,
+    trace_ids: Optional[dict[str, str]] = None,
     batch_floor: int = 1,
 ) -> list[FleetResult]:
     """Solve every tenant, batched by bucket class: one device dispatch
@@ -575,7 +578,7 @@ def solve_fleet(
             mode = resolve_fused_score(mode, k.p, k.n)
 
         warm_idx: list[int] = []
-        warm_dirty: dict[int, np.ndarray] = {}
+        warm_dirty: dict[int, NPArray] = {}
         cold_idx: list[int] = []
         for i in idxs:
             dirty = _warm_eligible(tenants[i], rec, record)

@@ -25,6 +25,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..core.encode import NPArray
 from ..core.hierarchy import find_ancestor, parents_to_children
 from ..utils.nativebuild import compile_cached
 from ..core.setops import strings_intersect, strings_remove
@@ -118,7 +119,7 @@ def _native_supported(
     return True
 
 
-def _ptr(arr: np.ndarray, ctype):
+def _ptr(arr: NPArray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 

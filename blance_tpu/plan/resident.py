@@ -57,6 +57,7 @@ import numpy as np
 
 from ..core.encode import (
     DenseProblem,
+    NPArray,
     decode_assignment,
     pack_slot_rows,
     strip_prev_rows,
@@ -86,8 +87,8 @@ class Proposal:
     the pre-solve ``prev`` (the only rows a clean pass may move)."""
 
     map: PartitionMap
-    packed: np.ndarray  # [P, S, R] int32
-    counts: np.ndarray  # [P, S] int64 per-row filled slots
+    packed: NPArray  # [P, S, R] int32
+    counts: NPArray  # [P, S] int64 per-row filled slots
     changed: list[str]
 
 
@@ -135,7 +136,7 @@ class EncodedState:
         self.max_level = problem.gids.shape[0] - 1
         self.gid_interns = _gid_interns(
             problem.nodes, opts.node_hierarchy, self.max_level)
-        self.counts: np.ndarray = \
+        self.counts: NPArray = \
             (problem.prev >= 0).sum(axis=2).astype(np.int64)
         # The held decoded map: None until a decode-produced proposal is
         # adopted — a caller-supplied map may spell rows differently
@@ -378,7 +379,7 @@ class EncodedState:
 
     # -- incremental decode --------------------------------------------------
 
-    def decode(self, assign: np.ndarray, current: PartitionMap,
+    def decode(self, assign: NPArray, current: PartitionMap,
                removes: list[str]) -> tuple[
                    PartitionMap, dict[str, list[str]], bool, int]:
         """Decode a solve against the resident state: patch the held

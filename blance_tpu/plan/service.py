@@ -404,7 +404,7 @@ class PlanService:
             t, carry=carry, dirty=t.dirty | cached_dirty)
 
     def _solve_batch(self, problems: list[TenantProblem],
-                     trace_ids: dict) -> tuple[
+                     trace_ids: dict[str, str]) -> tuple[
                          float, float, list[FleetResult]]:
         """The executor-side (or inline) solve, stamped on the
         recorder's clock: (t_solve_start, t_solve_end, results).  The

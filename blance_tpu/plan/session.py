@@ -35,11 +35,11 @@ docs/OBSERVABILITY.md for the plan.solve.warm/carry_* signals.
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING, Any, Optional
 
 import numpy as np
 
-from ..core.encode import decode_assignment, encode_problem
+from ..core.encode import NPArray, decode_assignment, encode_problem
 from ..core.types import (
     Partition,
     PartitionMap,
@@ -52,7 +52,7 @@ if TYPE_CHECKING:  # annotation-only: keep jax imports lazy at runtime
     from jax.sharding import Mesh
 
     from ..core.encode import DenseProblem
-    from .tensor import SolveCarry
+    from .tensor import Constraints, Rules, SolveCarry
 
 __all__ = ["PlannerSession"]
 
@@ -90,7 +90,7 @@ class PlannerSession:
         self._reencode(prev_map={})
         # current/proposed dense assignments [P, S, R] int32, -1 = empty.
         self.current = self._problem.prev.copy()
-        self.proposed: Optional[np.ndarray] = None
+        self.proposed: Optional[NPArray] = None
         # Warm-start state (docs/DESIGN.md "Incremental replanning") now
         # lives in a plan.carry.CarryCache entry — the session is a thin
         # view over one key.  The entry holds the SolveCarry matching
@@ -232,7 +232,7 @@ class PlannerSession:
         e = self._carries.peek(self._ckey)
         return e.carry if e is not None else None
 
-    def _mark_dirty(self, mask: np.ndarray) -> None:
+    def _mark_dirty(self, mask: NPArray) -> None:
         """Record delta marks.  Marks land in the post-proposal mask
         while a proposal is pending: the pending solve did not see this
         delta, so apply() must carry these forward instead of clearing
@@ -272,7 +272,7 @@ class PlannerSession:
                         ((prob.gids[lv][cur] == g) & held).any(axis=(1, 2)))
 
     def _capacity_shrank(self, carry: "SolveCarry",
-                         dirty: np.ndarray) -> bool:
+                         dirty: NPArray) -> bool:
         """Host-side warm-decline precheck, delegated to
         plan.carry.capacity_shrank (the extracted spelling the fleet
         tier shares); the session contributes its mesh shard count for
@@ -349,7 +349,7 @@ class PlannerSession:
 
     # -- the loop -------------------------------------------------------------
 
-    def replan(self) -> np.ndarray:
+    def replan(self) -> NPArray:
         """Solve placement from ``current`` on device; stores and returns
         the proposed assignment (does not adopt it — see apply()).
 
@@ -443,9 +443,9 @@ class PlannerSession:
         return assign
 
     def _warm_solve(
-        self, carry: "SolveCarry", dirty: np.ndarray, constraints: tuple,
-        rules: tuple, mode: str,
-    ) -> tuple[Optional[np.ndarray], Optional["SolveCarry"]]:
+        self, carry: "SolveCarry", dirty: NPArray,
+        constraints: "Constraints", rules: "Rules", mode: str,
+    ) -> tuple[Optional[NPArray], Optional["SolveCarry"]]:
         """One warm repair attempt; (None, None) on decline/failure."""
         from . import tensor as _tensor
         from ..obs import get_recorder
@@ -488,7 +488,7 @@ class PlannerSession:
             return None, None
 
     def _audit_gate(self, prob: "DenseProblem",
-                    assign: np.ndarray) -> bool:
+                    assign: NPArray) -> bool:
         """True when the audit policy is active AND finds violations —
         the warm path's fall-back-to-cold condition.  Respects
         opts.validate_assignment exactly like maybe_validate (None =
@@ -505,7 +505,7 @@ class PlannerSession:
             return False
         return any(check_assignment(prob, assign).values())
 
-    def recovery_replan(self, dead_nodes: list[str]) -> np.ndarray:
+    def recovery_replan(self, dead_nodes: list[str]) -> NPArray:
         """Failure-aware re-entry (rebalance_async recovery rounds):
         drain ``dead_nodes`` — nodes the orchestrator quarantined mid-
         transition — and replan.  ``remove_nodes`` marks exactly the
@@ -521,7 +521,7 @@ class PlannerSession:
 
     def replan_with_moves(
         self, favor_min_nodes: bool = False
-    ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    ) -> tuple[NPArray, tuple[NPArray, NPArray, NPArray]]:
         """Fused replan: solve + move diff + decode pack in ONE donated
         device dispatch (the plan pipeline, ROADMAP item 3).
 
@@ -585,9 +585,10 @@ class PlannerSession:
         return assign, darrs
 
     def _warm_pipeline(
-        self, carry: "SolveCarry", dirty: np.ndarray, constraints: tuple,
-        rules: tuple, mode: str, favor_min_nodes: bool,
-    ) -> "Optional[tuple]":
+        self, carry: "SolveCarry", dirty: NPArray,
+        constraints: "Constraints", rules: "Rules", mode: str,
+        favor_min_nodes: bool,
+    ) -> Optional[tuple[Any, ...]]:
         """One warm pipeline dispatch; None on decline/failure.
         Returns (assign, next_carry, (d_nodes, d_states, d_ops))."""
         import jax.numpy as jnp
@@ -595,7 +596,7 @@ class PlannerSession:
         from . import tensor as _tensor
         from ..obs import device as _obs_device
         from ..obs import get_recorder
-        from .tensor import SolveCarry
+        from .tensor import Constraints, Rules, SolveCarry
 
         prob = self._problem
         rec = get_recorder()
@@ -657,9 +658,9 @@ class PlannerSession:
             return None
 
     def _cold_pipeline(
-        self, constraints: tuple, rules: tuple, iters: int, mode: str,
-        favor_min_nodes: bool,
-    ) -> tuple:
+        self, constraints: "Constraints", rules: "Rules", iters: int,
+        mode: str, favor_min_nodes: bool,
+    ) -> tuple[Any, ...]:
         """Cold pipeline dispatch (mesh-sharded when the session has a
         mesh); returns (assign, next_carry, diff arrays)."""
         from . import tensor as _tensor
@@ -685,7 +686,7 @@ class PlannerSession:
 
     def moves(
         self, favor_min_nodes: bool = False
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    ) -> tuple[NPArray, NPArray, NPArray]:
         """On-device diff current -> proposed: (nodes, states, ops) as
         [P, L] arrays with -1 padding (see moves/batch.py for codes).
         Row i is partition ``self.problem.partitions[i]``."""

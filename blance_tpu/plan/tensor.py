@@ -45,7 +45,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.encode import DenseProblem, decode_assignment, encode_problem
+from ..core.encode import (
+    DenseProblem,
+    NPArray,
+    decode_assignment,
+    encode_problem,
+)
 from ..core.types import PartitionMap, PartitionModel, PlanOptions
 from ..obs import device as _device
 from ..obs import get_recorder, phase_span
@@ -71,6 +76,15 @@ __all__ = ["plan_next_map_tpu", "plan_pipeline", "solve_dense",
            "check_dense_memory", "sparse_rules_supported",
            "resolve_sparse_impl"]
 
+# Static solver-entry shapes (see plan/session.py where both are built
+# from the EncodedProblem): per-state slot counts, and per-state tuples
+# of (include_level, exclude_level) hierarchy-rule pairs.  The _hier_*
+# helpers below take ONE state's pair tuple (StateRules); every solve
+# entry takes the full per-state Rules.
+Constraints = tuple[int, ...]
+StateRules = tuple[tuple[int, int], ...]
+Rules = tuple[StateRules, ...]
+
 _INF = 1.0e9  # hard-forbidden
 _RULE_MISS = 1.0e6  # satisfies no hierarchy rule (uniform => flat fallback)
 _RULE_TIER = 1.0e4  # penalty step per rule index (earlier rules win)
@@ -90,7 +104,7 @@ _TIER_BAND_HEADROOM = 0.45  # max allowed within-tier mass, in tiers
 # Passed-check memo for _check_tier_band_scale: (array id + shape +
 # statics) -> weight fingerprint.  See the function for the safety
 # argument; bounded at 256 entries.
-_tier_scale_memo: dict = {}
+_tier_scale_memo: dict[tuple[object, ...], object] = {}
 _MAX_AUCTION_ROUNDS = 16
 # Bid-spreading jitter: above the advisory fill factor (0.001/P) by design,
 # below every decision-bearing term (stickiness >= 1.5 typical, rule tiers
@@ -217,7 +231,7 @@ class DenseScoreMemoryError(ValueError):
     ``projected_bytes`` / ``budget_bytes`` / ``shape`` (P, S, N)."""
 
     def __init__(self, projected_bytes: int, budget_bytes: int,
-                 shape: tuple):
+                 shape: tuple[int, ...]):
         self.projected_bytes = int(projected_bytes)
         self.budget_bytes = int(budget_bytes)
         self.shape = tuple(shape)
@@ -362,7 +376,7 @@ def _hier_penalty(
     anchors: jnp.ndarray,  # [P, A] GLOBAL node ids, -1 = absent anchor
     gids: jnp.ndarray,  # [L, N] full (anchor lookups are global)
     gid_valid: jnp.ndarray,  # [L, N] full
-    rules: tuple,  # ((include_level, exclude_level), ...)
+    rules: StateRules,  # ((include_level, exclude_level), ...)
     gids_cand: Optional[jnp.ndarray] = None,  # [L, N_l] candidate columns
 ) -> jnp.ndarray:
     """Tiered rule penalty [P, N] anchored on EVERY prior pick at once.
@@ -410,7 +424,7 @@ def _hier_tier_at(
     node: jnp.ndarray,  # [P] or [P, K] global node ids
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    rules: tuple,
+    rules: StateRules,
 ) -> jnp.ndarray:
     """_hier_penalty evaluated at gathered columns — O(rows * cols) ops.
 
@@ -437,7 +451,7 @@ def _hier_floor_counts(
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
     valid: jnp.ndarray,  # [N] full
-    rules: tuple,
+    rules: StateRules,
     taken_stack: Optional[jnp.ndarray] = None,  # [P, T] GLOBAL node ids
     # the row's partition already occupies; those columns are +INF in
     # the score, so a taken-aware floor must not count them attainable
@@ -575,7 +589,7 @@ def _member_ids(ids: jnp.ndarray, cols: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
-def _in_id_list(node: jnp.ndarray, id_list: list) -> jnp.ndarray:
+def _in_id_list(node: jnp.ndarray, id_list: list[jnp.ndarray]) -> jnp.ndarray:
     """[P] node id -> [P] bool: held by any of the [P] id columns."""
     out = jnp.zeros(node.shape[0], jnp.bool_)
     for ids in id_list:
@@ -795,9 +809,9 @@ def _sparse_score_cols(
     stick_si: jnp.ndarray,  # [P]
     prev_slot: jnp.ndarray,  # [P] global ids
     prev_state: jnp.ndarray,  # [P, R]
-    taken_ids: tuple,
+    taken_ids: tuple[jnp.ndarray, ...],
     anchors: Optional[jnp.ndarray],  # [P, A] (rules only)
-    rules: tuple,
+    rules: StateRules,
     jitter_scale: float,
 ) -> jnp.ndarray:
     """The MATRIX engine's score formula evaluated at gathered columns.
@@ -1110,8 +1124,8 @@ def _solve_assign(
     stickiness: jnp.ndarray,  # [P, S] float32
     gids: jnp.ndarray,  # [L, N] int32 (full)
     gid_valid: jnp.ndarray,  # [L, N] bool (full)
-    constraints: tuple,  # static, per-state slot counts
-    rules: tuple,  # static, per-state tuple of (inc, exc) pairs
+    constraints: Constraints,  # static, per-state slot counts
+    rules: Rules,  # static, per-state tuple of (inc, exc) pairs
     axis_name: Optional[str] = None,  # static; set under shard_map
     node_axis: Optional[str] = None,  # static; second mesh axis over nodes
     node_shards: int = 1,  # static; size of the node axis (N must divide)
@@ -1249,7 +1263,7 @@ def _solve_assign(
     # list stays kilobytes, membership tests become fusable compares (see
     # _member_ids), and global ids make every test node-shard invariant
     # with no psum gathers.
-    taken_ids: list = []
+    taken_ids: list[jnp.ndarray] = []
     # Global column ids of this shard's node window (noff = 0 unsharded).
     cols_l = jnp.arange(n_l, dtype=jnp.int32) + noff
 
@@ -1655,8 +1669,8 @@ def solve_dense(
     stickiness: jnp.ndarray,
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     node_axis: Optional[str] = None,
     node_shards: int = 1,
@@ -1687,8 +1701,8 @@ def _solve_dense_converged_impl(
     stickiness: jnp.ndarray,
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
     node_axis: Optional[str] = None,
@@ -1877,8 +1891,8 @@ def solve_dense_converged(
     stickiness: jnp.ndarray,
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
     node_axis: Optional[str] = None,
@@ -1974,8 +1988,8 @@ def _warm_repair(
     gid_valid: jnp.ndarray,
     dirty: jnp.ndarray,  # [P] bool — partitions the delta may move
     carry_used: jnp.ndarray,  # [S, N] SolveCarry.used matching prev
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     node_axis: Optional[str] = None,
     node_shards: int = 1,
@@ -2073,7 +2087,7 @@ def solve_dense_warm(
     constraints, rules, *, dirty, carry: SolveCarry,
     fused_score: str = "off", record: bool = True,
     donate: Optional[bool] = None, p_real=None,
-) -> tuple[Optional[np.ndarray], Optional[SolveCarry]]:
+) -> tuple[Optional[NPArray], Optional[SolveCarry]]:
     """Warm delta replan: repair sweep from the carry, or decline.
 
     Returns (assign, next_carry) when the repair is accepted as
@@ -2154,7 +2168,7 @@ def solve_dense_warm(
 # is bit-identical to the dense matrix engine, cold and warm.
 
 
-def sparse_rules_supported(rules: tuple) -> bool:
+def sparse_rules_supported(rules: Rules) -> bool:
     """True when the sparse engine can solve these rules (every
     exclude level strictly finer than its include level — the nesting
     tree shape the group-counting attainability floor requires)."""
@@ -2187,8 +2201,8 @@ def _solve_sparse_converged_impl(
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
     shortlist: jnp.ndarray,  # [P, K] ascending candidate ids, -1 pads
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
     sparse_impl: str = "xla",
@@ -2238,8 +2252,8 @@ def _warm_repair_sparse(
     shortlist: jnp.ndarray,
     dirty: jnp.ndarray,
     carry_used: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     sparse_impl: str = "xla",
     p_real: Optional[jnp.ndarray] = None,
@@ -2275,18 +2289,18 @@ _warm_repair_sparse_donating = jax.jit(
 
 
 def _sparse_fallback_rows(
-    assign: np.ndarray,  # [P, S, R] the sparse result (NOT mutated)
-    rows: np.ndarray,  # indices of exhausted rows
-    prev: np.ndarray,
-    pweights: np.ndarray,
-    nweights: np.ndarray,
-    valid: np.ndarray,
-    stickiness: np.ndarray,
-    gids: np.ndarray,
-    gid_valid: np.ndarray,
-    constraints: tuple,
-    rules: tuple,
-) -> np.ndarray:
+    assign: NPArray,  # [P, S, R] the sparse result (NOT mutated)
+    rows: NPArray,  # indices of exhausted rows
+    prev: NPArray,
+    pweights: NPArray,
+    nweights: NPArray,
+    valid: NPArray,
+    stickiness: NPArray,
+    gids: NPArray,
+    gid_valid: NPArray,
+    constraints: Constraints,
+    rules: Rules,
+) -> NPArray:
     """Per-row DENSE fallback for shortlist-exhausted partitions.
 
     Discards the flagged rows' sparse placements entirely and re-places
@@ -2329,7 +2343,7 @@ def _sparse_fallback_rows(
     pw_b = pw[rows]
     top_anchor = prev_b[:, 0, 0]
     new_rows = np.full((B, S, R), -1, np.int32)
-    taken: list[np.ndarray] = []
+    taken: list[NPArray] = []
     ar = np.arange(B)
     for si in range(S):
         kcon = int(constraints[si])
@@ -2402,10 +2416,10 @@ def _sparse_fallback_rows(
 
 
 def _apply_sparse_fallback(
-    assign: np.ndarray, exhausted: np.ndarray, prev, pweights, nweights,
+    assign: NPArray, exhausted: NPArray, prev, pweights, nweights,
     valid, stickiness, gids, gid_valid, constraints, rules,
     record: bool = True,
-) -> tuple[np.ndarray, int]:
+) -> tuple[NPArray, int]:
     """Route flagged rows through the dense fallback; returns
     (patched assign, rows re-placed).  Publishes the
     ``plan.sparse.shortlist_exhausted`` / ``dense_fallback_rows``
@@ -2524,7 +2538,7 @@ def solve_sparse_warm(
     k: Optional[int] = None, record: bool = True,
     donate: Optional[bool] = None, p_real=None,
     sparse_impl: Optional[str] = None,
-) -> tuple[Optional[np.ndarray], Optional[SolveCarry]]:
+) -> tuple[Optional[NPArray], Optional[SolveCarry]]:
     """Warm delta replan on the sparse engine: one carry-seeded repair
     sweep over the shortlist, or decline — the exact
     :func:`solve_dense_warm` contract ((None, None) on decline, carry
@@ -2612,8 +2626,8 @@ def _pipeline_cold_impl(
     stickiness: jnp.ndarray,
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
     node_axis: Optional[str] = None,
@@ -2659,8 +2673,8 @@ def _pipeline_warm_impl(
     gid_valid: jnp.ndarray,
     dirty: jnp.ndarray,
     carry_used: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     node_axis: Optional[str] = None,
     node_shards: int = 1,
@@ -2698,8 +2712,8 @@ def _pipeline_sparse_cold_impl(
     stickiness: jnp.ndarray,
     gids: jnp.ndarray,
     gid_valid: jnp.ndarray,
-    constraints: tuple,
-    rules: tuple,
+    constraints: Constraints,
+    rules: Rules,
     axis_name: Optional[str] = None,
     max_iterations: int = 10,
     shortlist_k: int = 16,
@@ -2948,7 +2962,7 @@ def plan_pipeline(
 
 def _dispatch_pipeline_cold(
     prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
-    constraints: tuple, rules: tuple, *, max_iterations: int,
+    constraints: Constraints, rules: Rules, *, max_iterations: int,
     fused_score: str, allow_fallback: bool, favor_min_nodes: bool,
     entry: str, timer=None, carry_used=None, p_real=None, donate=True,
 ):
@@ -3009,7 +3023,7 @@ def _dispatch_pipeline_cold(
 
 
 def _sparse_selected(opts: PlanOptions, p: int, s: int, n: int,
-                     rules: tuple) -> bool:
+                     rules: Rules) -> bool:
     """Route a plan through the sparse shortlist engine?
 
     ``opts.sparse`` True/False forces it (True + non-nesting rules is
@@ -3031,8 +3045,8 @@ def _sparse_selected(opts: PlanOptions, p: int, s: int, n: int,
         dense_score_budget_bytes()
 
 
-def _opts_shortlist_k(opts: PlanOptions, n: int, constraints: tuple,
-                      rules: tuple) -> int:
+def _opts_shortlist_k(opts: PlanOptions, n: int, constraints: Constraints,
+                      rules: Rules) -> int:
     """PlanOptions.sparse_k, or the auto-derived K."""
     from ..core.shortlist import auto_shortlist_k
 
@@ -3046,7 +3060,7 @@ def _opts_shortlist_k(opts: PlanOptions, n: int, constraints: tuple,
 
 def _dispatch_pipeline_sparse(
     prev_a, pw_a, nw_a, valid_a, stick_a, gids_a, gv_a,
-    constraints: tuple, rules: tuple, *, max_iterations: int,
+    constraints: Constraints, rules: Rules, *, max_iterations: int,
     shortlist_k: int, sparse_impl: str, favor_min_nodes: bool,
     entry: str, timer=None, carry_used=None, p_real=None, donate=True,
 ):
@@ -3133,7 +3147,7 @@ def solve_converged_resilient(
 
     rec = get_recorder()
 
-    def run(m: str) -> np.ndarray:
+    def run(m: str) -> NPArray:
         # Structured refusal instead of an opaque XLA OOM when the
         # matrix engine's projected [P, N] working set is over budget
         # (checked per attempt: an auto-fallback onto the matrix engine
@@ -3189,11 +3203,11 @@ def solve_converged_resilient(
 
 
 def _anchor_sat_np(
-    anchor: np.ndarray,  # [P] node ids, -1 = absent
-    gids: np.ndarray,  # [L, N]
-    gid_valid: np.ndarray,  # [L, N]
+    anchor: NPArray,  # [P] node ids, -1 = absent
+    gids: NPArray,  # [L, N]
+    gid_valid: NPArray,  # [L, N]
     rules: list[tuple[int, int]],
-) -> np.ndarray:
+) -> NPArray:
     """Per-rule satisfaction [n_rules, P, N] for ONE anchor column: does
     node n share the anchor's include-level ancestor and NOT its
     exclude-level ancestor?  Absent anchors satisfy everything.  Validity
@@ -3229,7 +3243,7 @@ def _audit_rules_nest(problem: DenseProblem) -> bool:
 
 
 def _count_hier_misses_fast(
-    problem: DenseProblem, assign: np.ndarray
+    problem: DenseProblem, assign: NPArray
 ) -> int:
     """Group-counting hierarchy audit: O(P·S·R·rules + N·L) host math.
 
@@ -3279,7 +3293,7 @@ def _count_hier_misses_fast(
     # Present ancestors are tree-consistent (same exclude group + present
     # include ancestor => same include group), so this joint count is
     # exactly |e ∩ g| for every e counted under g.
-    cnt_pair: dict[tuple[int, int], np.ndarray] = {}
+    cnt_pair: dict[tuple[int, int], NPArray] = {}
     for si in range(S):
         for (inc, exc) in (problem.rules.get(si) or []):
             if (inc, exc) in cnt_pair:
@@ -3291,7 +3305,7 @@ def _count_hier_misses_fast(
 
     top_anchor = problem.prev[:, 0, 0]
     misses = 0
-    used_ids: list[np.ndarray] = []  # [P] global node ids, -1 = none
+    used_ids: list[NPArray] = []  # [P] global node ids, -1 = none
 
     def point_sat(anchors, node, inc, exc):
         """[P] bool: does ``node`` satisfy (inc, exc) for every present
@@ -3324,7 +3338,7 @@ def _count_hier_misses_fast(
 
         # Subtract distinct anchor exclude groups (each nested inside the
         # shared include group, so each subtracts its full valid count).
-        e_seen: list[np.ndarray] = []
+        e_seen: list[NPArray] = []
         for a in anchors:
             aa = np.clip(a, 0, N - 1)
             e = np.where((a >= 0) & gid_valid[exc][aa], gids[exc][aa], -1)
@@ -3353,7 +3367,7 @@ def _count_hier_misses_fast(
         if rules_si:
             base = top_anchor if si == 0 else np.where(
                 assign[:, 0, 0] >= 0, assign[:, 0, 0], top_anchor)
-            anchors: list[np.ndarray] = [base]
+            anchors: list[NPArray] = [base]
             any_anchor = base >= 0
         for j in range(R):
             node_j = assign[:, si, j]
@@ -3385,7 +3399,7 @@ def _count_hier_misses_fast(
     return misses
 
 
-def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
+def _count_hier_misses(problem: DenseProblem, assign: NPArray) -> int:
     """Feasible-tier hierarchy misses: a copy counts when it sits at a
     WORSE rule tier than some still-open valid node could have achieved
     given the same anchors (the solver's prefix anchoring, reference
@@ -3414,7 +3428,7 @@ def _count_hier_misses(problem: DenseProblem, assign: np.ndarray) -> int:
 
 
 def _count_hier_misses_block(
-    problem: DenseProblem, assign: np.ndarray, prev: np.ndarray
+    problem: DenseProblem, assign: NPArray, prev: NPArray
 ) -> int:
     """One partition block of _count_hier_misses; per-anchor rule
     satisfaction folds in incrementally — each rule-bearing state costs
@@ -3458,7 +3472,7 @@ def _count_hier_misses_block(
 
 
 def check_assignment(
-    problem: DenseProblem, assign: np.ndarray
+    problem: DenseProblem, assign: NPArray
 ) -> dict[str, int]:
     """Constraint checker — the '0 violations' gate for the TPU backend.
 
@@ -3486,7 +3500,7 @@ def check_assignment(
         return {"duplicates": 0, "on_removed_nodes": 0,
                 "unfilled_feasible_slots": 0, "hierarchy_misses": 0}
 
-    def row_dups(rows: np.ndarray) -> np.ndarray:
+    def row_dups(rows: NPArray) -> NPArray:
         """Per row: count of valid entries equal to an earlier entry."""
         srt = np.sort(rows, axis=1)
         return ((srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] >= 0)).sum(axis=1)
@@ -3523,7 +3537,7 @@ _VALIDATE_AUTO_CELLS = 1 << 22
 
 
 def maybe_validate(
-    problem: DenseProblem, assign: np.ndarray, validate: Optional[bool],
+    problem: DenseProblem, assign: NPArray, validate: Optional[bool],
     context: str,
 ) -> Optional[dict[str, int]]:
     """Run check_assignment per the ``validate_assignment`` policy and

@@ -1136,3 +1136,441 @@ def test_cli_determinism_only_mode(capsys):
     assert "0 new finding(s)" in out
     # JIT/ASY/RACE baseline pins must NOT be reported stale in this mode.
     assert "stale baseline entry" not in out
+
+
+# -- donation lint: each rule trips, and its clean twin does not -------------
+
+# A self-contained donating dispatch family, the same wrapper shapes the
+# real package uses (_warm_repair_donating & co): jit-with-donate
+# module-level bindings over a shared impl.
+_DON_PRELUDE = """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    def _impl(prev, pweights, carry_used, constraints):
+        return prev, carry_used
+
+
+    _impl_jit = partial(jax.jit, static_argnames=("constraints",))(_impl)
+    _impl_donating = jax.jit(
+        _impl, static_argnames=("constraints",),
+        donate_argnames=("prev", "carry_used"))
+    _impl_nums = jax.jit(_impl, static_argnums=(3,), donate_argnums=(0,))
+"""
+
+
+def _don_findings(tmp_path, source, name="fix.py"):
+    from blance_tpu.analysis.donation import DonationPass
+
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(_DON_PRELUDE)
+                 + textwrap.dedent(source))
+    return DonationPass([str(f)], repo_root=str(tmp_path)).run()
+
+
+def test_don001_pr11_shape_trips(tmp_path):
+    # The PR-11 bug verbatim: the engine-exhaustion fallback re-reads
+    # prev after the donating dispatch instead of a pre-dispatch
+    # snapshot.
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry, fallback, donate=True):
+            impl = _impl_donating if donate else _impl_jit
+            out, used = impl(jnp.asarray(prev), jnp.asarray(pweights),
+                             jnp.asarray(carry.used), constraints=(2,))
+            return fallback(np.asarray(out), prev, pweights)
+    """)
+    assert _rules(fs) == ["DON001"]
+    assert fs[0].symbol == "warm"
+    assert "prev" in fs[0].message
+
+
+def test_don001_clean_snapshot_twin(tmp_path):
+    # The sanctioned fix: snapshot host-side BEFORE the dispatch
+    # (np.asarray dominates the donation), read the snapshot after.
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry, fallback, donate=True):
+            impl = _impl_donating if donate else _impl_jit
+            prev_fb = np.asarray(prev) if donate else prev
+            out, used = impl(jnp.asarray(prev), jnp.asarray(pweights),
+                             jnp.asarray(carry.used), constraints=(2,))
+            return fallback(np.asarray(out), prev_fb, pweights)
+    """)
+    assert fs == []
+
+
+def test_don001_packed_tuple_and_splat_dispatch_trips(tmp_path):
+    # The solve_dense_warm idiom: operands packed into dev_args and
+    # splatted into the dispatch — a post-dispatch read of the packed
+    # tuple's element is still a read of the donated buffer.
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            dev_args = (jnp.asarray(prev), jnp.asarray(pweights),
+                        jnp.asarray(carry.used))
+            out, used = _impl_donating(*dev_args, constraints=(2,))
+            return dev_args[0] + out
+    """)
+    assert _rules(fs) == ["DON001"]
+
+
+def test_don001_attribute_root_trips(tmp_path):
+    # Donating straight off self.current, then returning it: the
+    # session-state shape of the same bug.
+    fs = _don_findings(tmp_path, """
+        class Session:
+            def warm(self, pweights, carry):
+                out, used = _impl_donating(
+                    jnp.asarray(self.current), pweights,
+                    jnp.asarray(carry.used), constraints=(2,))
+                return self.current
+    """)
+    assert _rules(fs) == ["DON001"]
+
+
+def test_don001_returning_donated_operand_trips(tmp_path):
+    # Returning the donated operand hands the invalidated buffer to the
+    # caller — a read-at-a-distance.
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            return prev
+    """)
+    assert _rules(fs) == ["DON001"]
+
+
+def test_don001_metadata_reads_are_clean(tmp_path):
+    # .shape/.dtype survive donation (the aval outlives the buffer).
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            return out.reshape(prev.shape), prev.dtype
+    """)
+    assert fs == []
+
+
+def test_don001_donate_argnums_positional_mapping_trips(tmp_path):
+    # donate_argnums resolve through the wrapped signature to the same
+    # parameter names donate_argnames would use.
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_nums(jnp.asarray(prev), pweights,
+                                   jnp.asarray(carry.used), (2,))
+            return prev
+    """)
+    assert _rules(fs) == ["DON001"]
+
+
+def test_don002_escape_before_dispatch_trips(tmp_path):
+    # Stashing the operand on self before donating it: another window
+    # can observe the invalidated buffer (the CarryCache risk surface).
+    fs = _don_findings(tmp_path, """
+        class Session:
+            def warm(self, prev, pweights, carry):
+                self._stash = prev
+                out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                           jnp.asarray(carry.used),
+                                           constraints=(2,))
+                return out
+    """)
+    assert _rules(fs) == ["DON002"]
+
+
+def test_don002_store_method_escape_trips(tmp_path):
+    fs = _don_findings(tmp_path, """
+        class Session:
+            def warm(self, prev, pweights, carry):
+                self.cache.store("k", prev)
+                out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                           jnp.asarray(carry.used),
+                                           constraints=(2,))
+                return out
+    """)
+    assert _rules(fs) == ["DON002"]
+
+
+def test_don002_storing_the_output_is_clean(tmp_path):
+    # Escaping the dispatch OUTPUT is the normal result path, not a
+    # donated-operand escape.
+    fs = _don_findings(tmp_path, """
+        class Session:
+            def warm(self, prev, pweights, carry):
+                out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                           jnp.asarray(carry.used),
+                                           constraints=(2,))
+                self._stash = np.asarray(out)
+                return out
+    """)
+    assert fs == []
+
+
+def test_don003_double_dispatch_trips(tmp_path):
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            out2, used2 = _impl_donating(jnp.asarray(prev), pweights,
+                                         used, constraints=(2,))
+            return out2
+    """)
+    assert _rules(fs) == ["DON003"]
+
+
+def test_don003_rebound_redispatch_is_clean(tmp_path):
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            prev = np.asarray(out)
+            out2, used2 = _impl_donating(jnp.asarray(prev), pweights,
+                                         used, constraints=(2,))
+            return out2
+    """)
+    assert fs == []
+
+
+def test_don004_post_dispatch_snapshot_trips(tmp_path):
+    # Snapshotting AFTER the dispatch reads the invalidated buffer; the
+    # same call BEFORE the dispatch is the fix recipe and stays clean
+    # (test_don001_clean_snapshot_twin).
+    fs = _don_findings(tmp_path, """
+        def warm(prev, pweights, carry):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            keep = np.asarray(prev)
+            return out, keep
+    """)
+    assert _rules(fs) == ["DON004"]
+
+
+def test_donation_real_package_is_clean():
+    """The real package carries ZERO donation findings, baselined or
+    not — the PR-11 snapshot fixes cover every donating dispatch."""
+    from blance_tpu.analysis import PACKAGE_ROOT, REPO_ROOT, _iter_py_files
+    from blance_tpu.analysis.donation import DonationPass
+
+    findings = DonationPass(
+        _iter_py_files([PACKAGE_ROOT]), REPO_ROOT).run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_donation_registry_sees_real_donating_wrappers():
+    """The wrapper registry must resolve every jit-with-donate binding
+    the package actually declares — a parse regression here would turn
+    the whole pass into a silent no-op."""
+    from blance_tpu.analysis import PACKAGE_ROOT, REPO_ROOT, _iter_py_files
+    from blance_tpu.analysis.donation import DonationPass
+
+    p = DonationPass(_iter_py_files([PACKAGE_ROOT]), REPO_ROOT)
+    p.run()
+    by_name = {fq.rsplit(".", 1)[-1]: dc
+               for fq, dc in p.registry.items()}
+    assert by_name["_warm_repair_donating"].donated == (
+        "prev", "carry_used")
+    assert by_name["_warm_repair_sparse_donating"].donated == (
+        "prev", "carry_used")
+    assert by_name["_pipeline_cold_donating"].donated == ("prev",)
+    assert by_name["_pipeline_warm_donating"].donated == (
+        "prev", "carry_used")
+    assert by_name["_pipeline_sparse_donating"].donated == ("prev",)
+
+
+def test_cli_donation_only_mode(capsys):
+    from blance_tpu.analysis.__main__ import main
+
+    rc = main(["--donation"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 new finding(s)" in out
+    # Other passes' baseline pins must NOT be reported stale in this mode.
+    assert "stale baseline entry" not in out
+
+
+def test_cli_donation_catches_seeded_pr11_regression(tmp_path, capsys):
+    # The acceptance fixture: re-introduce the PR-11 sparse-warm read
+    # and the CLI must fail with DON001.
+    bad = tmp_path / "fix.py"
+    bad.write_text(textwrap.dedent(_DON_PRELUDE) + textwrap.dedent("""
+        def warm(prev, pweights, carry, fallback):
+            out, used = _impl_donating(jnp.asarray(prev), pweights,
+                                       jnp.asarray(carry.used),
+                                       constraints=(2,))
+            return fallback(np.asarray(out), prev, pweights)
+    """))
+    from blance_tpu.analysis.__main__ import main
+
+    rc = main(["--donation", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DON001" in out
+
+
+# -- JIT005: donate_argnums validation (the PR-20 gap-fill) ------------------
+
+
+def test_jit005_donate_argnums_out_of_range_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        def f(x, y, mode):
+            return x
+
+        g = jax.jit(f, static_argnames=("mode",), donate_argnums=(5,))
+    """)
+    assert _rules(fs) == ["JIT005"]
+    assert "outside" in fs[0].message
+
+
+def test_jit005_donate_argnums_static_overlap_trips(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        def f(x, y, mode):
+            return x
+
+        g = jax.jit(f, static_argnames=("mode",), donate_argnums=(2,))
+    """)
+    assert _rules(fs) == ["JIT005"]
+    assert "static_argnames" in fs[0].message
+
+
+def test_jit005_donate_argnums_clean_twin(tmp_path):
+    fs = _jit_findings(tmp_path, """
+        import jax
+
+        def f(x, y, mode):
+            return x
+
+        g = jax.jit(f, static_argnames=("mode",), donate_argnums=(0, 1))
+    """)
+    assert fs == []
+
+
+# -- membudget: the declarative HBM-ceiling table ----------------------------
+
+
+def _mb_patched(monkeypatch, budgets, entries=None):
+    """Shrink the membudget pass to a controlled (budgets, builders)
+    pair; measurement stays real (AOT on abstract operands — cheap at
+    the entries these tests keep)."""
+    from blance_tpu.analysis import membudget as mb
+
+    orig = mb._builders()
+    keep = {e: orig[e] for e in (entries or []) if e in orig}
+    monkeypatch.setattr(mb, "HBM_BUDGETS", budgets)
+    monkeypatch.setattr(mb, "_builders", lambda: keep)
+    return mb
+
+
+def test_mem001_over_budget_trips(monkeypatch):
+    mb = _mb_patched(monkeypatch, {"sched.ranks": {"smoke": 1}},
+                     entries=["sched.ranks"])
+    findings, n = mb.run_membudget_check()
+    assert _rules(findings) == ["MEM001"]
+    assert n == 1
+    assert findings[0].symbol == "sched.ranks@smoke"
+
+
+def test_mem001_within_budget_is_clean(monkeypatch):
+    mb = _mb_patched(monkeypatch, {"sched.ranks": {"smoke": 100_000}},
+                     entries=["sched.ranks"])
+    findings, n = mb.run_membudget_check()
+    assert findings == []
+    assert n == 1
+
+
+def test_mem002_table_drift_trips(monkeypatch):
+    # All three drift shapes at once: a budget row with no builder, a
+    # builder with no row, and a row for a mesh-exempt entry.
+    mb = _mb_patched(monkeypatch,
+                     {"ghost.entry": {"smoke": 5},
+                      "sharded.cold": {"smoke": 5}},
+                     entries=["sched.ranks"])
+    findings, _ = mb.run_membudget_check()
+    assert _rules(findings) == ["MEM002"]
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ["ghost.entry", "sched.ranks", "sharded.cold"]
+
+
+def test_mem002_unknown_class_trips(monkeypatch):
+    mb = _mb_patched(monkeypatch, {"sched.ranks": {"bogus": 5}},
+                     entries=["sched.ranks"])
+    findings, _ = mb.run_membudget_check()
+    assert _rules(findings) == ["MEM002"]
+    assert findings[0].symbol == "sched.ranks@bogus"
+
+
+def test_mem003_dense_row_past_guard_trips(monkeypatch):
+    # A dense-engine budget at the north-star class: check_dense_memory
+    # rejects a 100k x 10k score matrix at dispatch, so the row is dead
+    # and MEM003 must say so (structurally — the gated class is never
+    # AOT-compiled).
+    mb = _mb_patched(monkeypatch,
+                     {"solve_dense.cold": {"north": 10}},
+                     entries=["solve_dense.cold"])
+    findings, _ = mb.run_membudget_check()
+    assert _rules(findings) == ["MEM003"]
+    assert findings[0].symbol == "solve_dense.cold@north"
+
+
+def test_membudget_real_table_is_structurally_sound():
+    """MEM002/MEM003 over the REAL table without any measurement:
+    every builder budgeted, no dead/exempt/unknown rows."""
+    from blance_tpu.analysis import membudget as mb
+
+    assert set(mb._builders()) == set(mb.HBM_BUDGETS)
+    assert not (set(mb.HBM_BUDGETS) & mb.MESH_EXEMPT)
+    for ent, rows in mb.HBM_BUDGETS.items():
+        assert set(rows) <= set(mb.SHAPE_CLASSES), (ent, rows)
+        for klass, budget in rows.items():
+            assert budget > 0
+        if ent in mb._DENSE_ENTRIES:
+            for klass in rows:
+                d = mb.SHAPE_CLASSES[klass]
+                from blance_tpu.plan.tensor import projected_score_bytes
+
+                assert projected_score_bytes(d.P, d.N) <= \
+                    mb._DENSE_GUARD_REF_BYTES, (ent, klass)
+
+
+def test_membudget_entries_match_live_dispatch_labels():
+    """Reality guard: every budgeted/exempted entry label must appear
+    as a string literal in the dispatch modules — a renamed
+    obs/device.entry label would otherwise leave a dead ceiling that
+    MEM002 can't see (the builder registry renames with the code, the
+    label string does not)."""
+    import ast
+    import os
+
+    from blance_tpu.analysis import PACKAGE_ROOT
+    from blance_tpu.analysis import membudget as mb
+
+    dispatch_modules = [
+        os.path.join(PACKAGE_ROOT, "plan", "tensor.py"),
+        os.path.join(PACKAGE_ROOT, "plan", "session.py"),
+        os.path.join(PACKAGE_ROOT, "plan", "fleet.py"),
+        os.path.join(PACKAGE_ROOT, "parallel", "sharded.py"),
+        os.path.join(PACKAGE_ROOT, "orchestrate", "sched", "ranks.py"),
+    ]
+    literals = set()
+    for path in dispatch_modules:
+        with open(path) as fh:
+            tree = ast.parse(fh.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                literals.add(node.value)
+    for label in sorted(set(mb.HBM_BUDGETS) | mb.MESH_EXEMPT):
+        assert label in literals, (
+            f"membudget entry {label!r} does not appear in any dispatch "
+            f"module — the live entry label moved; update HBM_BUDGETS/"
+            f"MESH_EXEMPT")
